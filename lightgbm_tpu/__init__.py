@@ -1,0 +1,41 @@
+"""lightgbm_tpu: a TPU-native gradient-boosted decision tree framework.
+
+Capability surface of LightGBM (reference: xyzhou-puck/LightGBM — see
+SURVEY.md; the mount was empty so the upstream-derived survey is the spec),
+re-designed TPU-first on JAX/XLA: histogram split finding as one-hot
+matmuls on the MXU, leaf-wise growth as a jitted while_loop, per-row
+leaf-id partitioning, and mesh collectives (psum/psum_scatter/all_gather)
+in place of the reference's socket/MPI/NCCL distributed learners.
+"""
+from .basic import Booster, Dataset, LightGBMError
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .engine import CVBooster, cv, train
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Booster", "Dataset", "LightGBMError", "Config",
+    "train", "cv", "CVBooster",
+    "early_stopping", "log_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+]
+
+
+def __getattr__(name):
+    # lazy submodule-level exports (sklearn API, plotting) to keep import
+    # light; mirrors python-package/lightgbm/__init__.py's surface
+    try:
+        if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor",
+                    "LGBMRanker"):
+            from . import sklearn as _sk
+            return getattr(_sk, name)
+        if name in ("plot_importance", "plot_metric", "plot_tree",
+                    "create_tree_digraph"):
+            from . import plotting as _pl
+            return getattr(_pl, name)
+    except ImportError as e:
+        raise AttributeError(
+            f"module 'lightgbm_tpu' has no attribute {name!r}: {e}") from e
+    raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

@@ -1,0 +1,245 @@
+"""Booster: the user-facing training/prediction handle.
+
+Reference: python-package/lightgbm/basic.py (UNVERIFIED — empty mount, see
+SURVEY.md banner). There, ``Booster`` is a ctypes proxy over the C API's
+LGBM_Booster* handles; here it wraps the in-process GBDT engine directly —
+the TPU framework is Python-hosted, so the ABI seam the reference needs
+(C API, SURVEY.md §1 L7) collapses into this class while keeping the same
+method surface.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .boosting.gbdt import GBDT
+from .config import Config
+from .io.dataset import Dataset
+from .utils import log
+from .utils.log import LightGBMError
+
+__all__ = ["Booster", "Dataset", "LightGBMError"]
+
+
+class Booster:
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._engine: Optional[GBDT] = None
+        self._from_model = None
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance")
+            self.config = Config(self.params)
+            train_set.params.setdefault("max_bin", self.config.max_bin)
+            for key in ("min_data_in_bin", "bin_construct_sample_cnt",
+                        "use_missing", "zero_as_missing",
+                        "data_random_seed"):
+                train_set.params.setdefault(key, getattr(self.config, key))
+            self._engine = GBDT(self.config, train_set)
+            self.train_set = train_set
+        elif model_file is not None or model_str is not None:
+            from .io.model_text import load_model_string
+            if model_file is not None:
+                with open(model_file) as f:
+                    model_str = f.read()
+            self._from_model = load_model_string(model_str)
+            self.config = Config(self.params)
+        else:
+            raise TypeError("At least one of train_set, model_file or "
+                            "model_str should be provided")
+
+    # ------------------------------------------------------------------
+    @property
+    def engine(self) -> GBDT:
+        if self._engine is None:
+            raise LightGBMError("Booster has no training engine "
+                                "(loaded from model file)")
+        return self._engine
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        self.engine.add_valid(data, name)
+        if not hasattr(self, "_valid_sets"):
+            self._valid_sets = []
+        self._valid_sets.append(data)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None,
+               fobj: Optional[Callable] = None) -> bool:
+        """Run one boosting iteration; returns True if stopped early."""
+        if train_set is not None and train_set is not self.train_set:
+            raise LightGBMError("Replacing train_set mid-training is not "
+                                "supported")
+        if fobj is not None:
+            preds = self._inner_raw_predict()
+            grad, hess = fobj(preds, self.train_set)
+            self.engine.train_one_iter(np.asarray(grad), np.asarray(hess))
+        else:
+            self.engine.train_one_iter()
+        return False
+
+    def _inner_raw_predict(self) -> np.ndarray:
+        eng = self.engine
+        raw = np.asarray(eng.score)[:eng.data.n]
+        if eng.num_class == 1:
+            return raw[:, 0].astype(np.float64)
+        return raw.astype(np.float64).reshape(-1, order="F")
+
+    def rollback_one_iter(self) -> "Booster":
+        self.engine.rollback_one_iter()
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self.config.update(params)
+        # rebuild jitted step so learning-rate etc. take effect
+        self.engine.config = self.config
+        self.engine._build_step()
+        return self
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None) -> List:
+        return self._eval(-1, feval)
+
+    def eval_valid(self, feval=None) -> List:
+        out = []
+        for i in range(len(self.engine.valid_data)):
+            out.extend(self._eval(i, feval))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List:
+        for i, n in enumerate(self.engine.valid_names):
+            if n == name:
+                return self._eval(i, feval)
+        self.add_valid(data, name)
+        return self._eval(len(self.engine.valid_names) - 1, feval)
+
+    def _eval(self, which: int, feval=None) -> List:
+        results = self.engine.eval_set(which)
+        if feval is not None:
+            eng = self.engine
+            if which < 0:
+                ds, raw = self.train_set, np.asarray(
+                    eng.score)[:eng.data.n]
+                name = "training"
+            else:
+                dd = eng.valid_data[which]
+                raw = np.asarray(eng.valid_scores[which])[:dd.n]
+                name = eng.valid_names[which]
+                ds = getattr(self, "_valid_sets", [None] * (which + 1))[which]
+            preds = raw[:, 0] if eng.num_class == 1 else raw
+            fret = feval(preds.astype(np.float64), ds)
+            if fret is not None:
+                items = fret if isinstance(fret, list) else [fret]
+                for metric_name, value, higher_better in items:
+                    results.append((name, metric_name, value,
+                                    higher_better))
+        return results
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **_kwargs) -> np.ndarray:
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
+        if self._from_model is not None:
+            return self._from_model.predict(
+                data, raw_score=raw_score, start_iteration=start_iteration,
+                num_iteration=num_iteration, pred_leaf=pred_leaf,
+                pred_contrib=pred_contrib)
+        if pred_contrib:
+            from .io.model_text import HostModel
+            return self._to_host_model().predict(
+                data, raw_score=raw_score, start_iteration=start_iteration,
+                num_iteration=num_iteration, pred_contrib=True)
+        return self.engine.predict(
+            data, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration or -1, pred_leaf=pred_leaf)
+
+    # ------------------------------------------------------------------
+    def _to_host_model(self):
+        from .io.model_text import HostModel
+        return HostModel.from_engine(self.engine, self.config,
+                                     best_iteration=self.best_iteration)
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        from .io.model_text import save_model_string
+        if self._from_model is not None:
+            return save_model_string(self._from_model)
+        return save_model_string(self._to_host_model())
+
+    def save_model(self, filename: str,
+                   num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration,
+                                         importance_type))
+        return self
+
+    # ------------------------------------------------------------------
+    def num_trees(self) -> int:
+        if self._from_model is not None:
+            return len(self._from_model.trees)
+        return self.engine.num_trees()
+
+    def current_iteration(self) -> int:
+        if self._from_model is not None:
+            return len(self._from_model.trees) \
+                // max(self._from_model.num_class, 1)
+        return self.engine.current_iteration
+
+    def num_model_per_iteration(self) -> int:
+        if self._from_model is not None:
+            return self._from_model.num_class
+        return self.engine.num_class
+
+    def num_feature(self) -> int:
+        if self._from_model is not None:
+            return self._from_model.max_feature_idx + 1
+        return self.train_set.num_total_features
+
+    def feature_name(self) -> List[str]:
+        if self._from_model is not None:
+            return list(self._from_model.feature_names)
+        return list(self.train_set.feature_names)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        """Split-count or total-gain importance (GBDT::FeatureImportance)."""
+        if self._from_model is not None:
+            trees = self._from_model.trees
+            n_feat = self._from_model.max_feature_idx + 1
+            used = list(range(n_feat))
+        else:
+            trees = self.engine.models
+            n_feat = self.train_set.num_total_features
+            used = self.train_set.used_features
+        if iteration is not None and iteration > 0:
+            trees = trees[:iteration * self.num_model_per_iteration()]
+        imp = np.zeros(n_feat, dtype=np.float64)
+        for t in trees:
+            for i in range(t.num_nodes):
+                f = used[int(t.split_feature[i])]
+                if importance_type == "gain":
+                    imp[f] += float(t.split_gain[i])
+                else:
+                    imp[f] += 1.0
+        if importance_type == "split":
+            return imp.astype(np.int64)
+        return imp
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        return self

@@ -1,0 +1,130 @@
+"""Callback protocol for the training loop.
+
+Reference: python-package/lightgbm/callback.py (UNVERIFIED — empty mount,
+see SURVEY.md banner): callbacks receive a ``CallbackEnv`` namedtuple
+before/after each iteration; ``EarlyStopException`` unwinds the loop.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Tuple
+
+from .utils import log
+
+
+class EarlyStopException(Exception):
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            parts = [
+                f"{name}'s {metric}: {value:g}"
+                for name, metric, value, _ in env.evaluation_result_list]
+            log.info(f"[{env.iteration + 1}]\t" + "\t".join(parts))
+    _callback.order = 10
+    return _callback
+
+
+def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+
+    def _callback(env: CallbackEnv) -> None:
+        for name, metric, value, _ in env.evaluation_result_list:
+            eval_result.setdefault(name, collections.OrderedDict())
+            eval_result[name].setdefault(metric, []).append(value)
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs: Any) -> Callable:
+    """Reset parameters (e.g. learning_rate schedule) per iteration."""
+
+    def _callback(env: CallbackEnv) -> None:
+        new_params = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"Length of list {key!r} has to equal "
+                        "num_boost_round")
+                new_params[key] = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_params[key] = value(
+                    env.iteration - env.begin_iteration)
+        if new_params:
+            env.model.reset_parameter(new_params)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True, min_delta: float = 0.0) -> Callable:
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List[Any] = []
+    cmp_op: List[Callable] = []
+    enabled = [True]
+    first_metric = [""]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = bool(env.evaluation_result_list)
+        if not enabled[0]:
+            log.warning("Early stopping is not available in dart mode or "
+                        "without valid sets")
+            return
+        if verbose:
+            log.info(f"Training until validation scores don't improve for "
+                     f"{stopping_rounds} rounds")
+        first_metric[0] = env.evaluation_result_list[0][1]
+        for *_head, higher_better in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+            if higher_better:
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda x, y: x > y + min_delta)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda x, y: x < y - min_delta)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not best_score:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, (name, metric, value, _hb) in \
+                enumerate(env.evaluation_result_list):
+            if best_score_list[i] is None or cmp_op[i](value,
+                                                       best_score[i]):
+                best_score[i] = value
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            if first_metric_only and first_metric[0] != metric:
+                continue
+            if name == "training":
+                continue  # train metric does not trigger early stopping
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    log.info(f"Early stopping, best iteration is:\n"
+                             f"[{best_iter[i] + 1}]")
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    log.info(f"Did not meet early stopping. Best iteration "
+                             f"is:\n[{best_iter[i] + 1}]")
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+    _callback.order = 30
+    return _callback

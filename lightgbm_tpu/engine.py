@@ -1,0 +1,236 @@
+"""Training entry points: ``train()`` and ``cv()``.
+
+Reference: python-package/lightgbm/engine.py (UNVERIFIED — empty mount,
+see SURVEY.md banner): the callback loop around Booster.update, valid-set
+registration, early stopping via EarlyStopException, CV fold construction
+(group-aware for ranking) and aggregated eval.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import Config
+from .utils import log
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def _resolve_num_boost_round(params: Dict[str, Any],
+                             num_boost_round: int) -> int:
+    cfg_alias = Config.canonical_name
+    for key in list(params):
+        if cfg_alias(key) == "num_iterations":
+            return int(params.pop(key))
+    return num_boost_round
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None,
+          fobj: Optional[Callable] = None) -> Booster:
+    """Train a model (mirrors lightgbm.train)."""
+    params = copy.deepcopy(params)
+    num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    cfg = Config(params)
+    if callable(params.get("objective")):
+        fobj = params["objective"]
+        params["objective"] = "custom"
+        cfg = Config(params)
+
+    if init_model is not None:
+        log.warning("init_model training continuation is not wired into the "
+                    "engine yet; starting fresh")  # TODO: continuation
+
+    booster = Booster(params=params, train_set=train_set)
+    if valid_sets:
+        valid_names = valid_names or [f"valid_{i}"
+                                      for i in range(len(valid_sets))]
+        for vs, name in zip(valid_sets, valid_names):
+            if vs is train_set:
+                continue  # the train set is evaluated via eval_train
+            booster.add_valid(vs, name)
+
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(callback_mod.early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only,
+            verbose=cfg.verbosity >= 1,
+            min_delta=cfg.early_stopping_min_delta))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    train_as_valid = valid_sets and any(vs is train_set
+                                        for vs in valid_sets)
+
+    for it in range(num_boost_round):
+        env_pre = callback_mod.CallbackEnv(
+            model=booster, params=params, iteration=it,
+            begin_iteration=0, end_iteration=num_boost_round,
+            evaluation_result_list=None)
+        for cb in callbacks_before:
+            cb(env_pre)
+        booster.update(fobj=fobj)
+
+        eval_results = []
+        should_eval = ((booster.engine.valid_data or train_as_valid
+                        or cfg.is_provide_training_metric)
+                       and (it + 1) % cfg.metric_freq == 0)
+        if should_eval:
+            if cfg.is_provide_training_metric or train_as_valid:
+                eval_results.extend(booster.eval_train(feval))
+            eval_results.extend(booster.eval_valid(feval))
+        env = callback_mod.CallbackEnv(
+            model=booster, params=params, iteration=it,
+            begin_iteration=0, end_iteration=num_boost_round,
+            evaluation_result_list=eval_results)
+        try:
+            for cb in callbacks_after:
+                cb(env)
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for name, metric, value, _ in (e.best_score or []):
+                booster.best_score.setdefault(name, {})[metric] = value
+            break
+    if booster.best_iteration < 0:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+class CVBooster:
+    """Container of per-fold boosters (mirrors lightgbm.CVBooster)."""
+
+    def __init__(self, boosters: Optional[List[Booster]] = None):
+        self.boosters = list(boosters or [])
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name: str):
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs)
+                    for b in self.boosters]
+        return handler
+
+
+def _make_folds(full_data: Dataset, nfold: int, stratified: bool,
+                shuffle: bool, seed: int):
+    full_data.construct()
+    n = full_data.num_data
+    qb = full_data.metadata.query_boundaries
+    rng = np.random.default_rng(seed)
+    if qb is not None:
+        # group-aware folds: split whole queries
+        nq = len(qb) - 1
+        q_idx = rng.permutation(nq) if shuffle else np.arange(nq)
+        for k in range(nfold):
+            test_q = q_idx[k::nfold]
+            test_rows = np.concatenate(
+                [np.arange(qb[q], qb[q + 1]) for q in test_q]) \
+                if len(test_q) else np.array([], dtype=np.int64)
+            mask = np.zeros(n, dtype=bool)
+            mask[test_rows] = True
+            yield np.flatnonzero(~mask), np.flatnonzero(mask)
+        return
+    label = full_data.metadata.label
+    if stratified and label is not None:
+        order = []
+        for cls in np.unique(label):
+            idx = np.flatnonzero(label == cls)
+            if shuffle:
+                idx = rng.permutation(idx)
+            order.append(idx)
+        interleaved = np.concatenate(order)
+        folds = [interleaved[k::nfold] for k in range(nfold)]
+    else:
+        idx = rng.permutation(n) if shuffle else np.arange(n)
+        folds = [idx[k::nfold] for k in range(nfold)]
+    for k in range(nfold):
+        mask = np.zeros(n, dtype=bool)
+        mask[folds[k]] = True
+        yield np.flatnonzero(~mask), np.flatnonzero(mask)
+
+
+def cv(params: Dict[str, Any], train_set: Dataset,
+       num_boost_round: int = 100, folds=None, nfold: int = 5,
+       stratified: bool = True, shuffle: bool = True,
+       metrics: Optional[Union[str, List[str]]] = None,
+       feval: Optional[Callable] = None, seed: int = 0,
+       callbacks: Optional[List[Callable]] = None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (mirrors lightgbm.cv)."""
+    params = copy.deepcopy(params)
+    num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config(params)
+    if cfg.objective not in ("binary", "multiclass", "multiclassova"):
+        stratified = False
+    train_set.construct()
+
+    if folds is not None:
+        fold_iter = list(folds)
+    else:
+        fold_iter = list(_make_folds(train_set, nfold, stratified, shuffle,
+                                     seed))
+
+    cvbooster = CVBooster()
+    fold_valid = []
+    for train_idx, test_idx in fold_iter:
+        dtrain = train_set.subset(train_idx)
+        dtest = train_set.subset(test_idx)
+        bst = Booster(params=params, train_set=dtrain)
+        bst.add_valid(dtest, "valid")
+        cvbooster.append(bst)
+        fold_valid.append(dtest)
+
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(callback_mod.early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only,
+            verbose=cfg.verbosity >= 1))
+    callbacks.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    results: Dict[str, List[float]] = {}
+    for it in range(num_boost_round):
+        per_metric: Dict[str, List[float]] = {}
+        for bst in cvbooster.boosters:
+            bst.update()
+            for name, metric, value, hb in bst.eval_valid(feval):
+                per_metric.setdefault((metric, hb), []).append(value)
+        agg = []
+        for (metric, hb), values in per_metric.items():
+            mean, std = float(np.mean(values)), float(np.std(values))
+            results.setdefault(f"valid {metric}-mean", []).append(mean)
+            results.setdefault(f"valid {metric}-stdv", []).append(std)
+            agg.append(("cv_agg", metric, mean, hb))
+        env = callback_mod.CallbackEnv(
+            model=cvbooster, params=params, iteration=it,
+            begin_iteration=0, end_iteration=num_boost_round,
+            evaluation_result_list=agg)
+        try:
+            for cb in callbacks:
+                cb(env)
+        except callback_mod.EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for key in results:
+                results[key] = results[key][:cvbooster.best_iteration]
+            break
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster
+    return results

@@ -1,0 +1,310 @@
+"""Binned dataset + metadata.
+
+Reference: src/io/dataset.cpp, src/io/metadata.cpp,
+include/LightGBM/dataset.h (UNVERIFIED — empty mount, see SURVEY.md banner).
+
+TPU-first representational choice (SURVEY.md §7.1): instead of the
+reference's per-feature-group ``Bin`` objects (dense/sparse/multi-val
+hierarchies tuned for CPU caches), the binned matrix is ONE packed integer
+array ``[n_rows, n_used_features]`` (uint8 when every feature has <=256
+bins) destined for HBM, row-sharded over the mesh. EFB still happens at bin
+time (bundled features share a column with bin offsets) — see bundling.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..utils import log
+from .binning import BIN_TYPE_CATEGORICAL, BinMapper, find_bin_mappers
+
+
+@dataclasses.dataclass
+class Metadata:
+    """Per-row training metadata (reference: Metadata, metadata.cpp)."""
+
+    label: Optional[np.ndarray] = None
+    weight: Optional[np.ndarray] = None
+    # query boundaries: int array of size num_queries+1 (cumulative), like
+    # the reference's query_boundaries_ built from per-query counts
+    query_boundaries: Optional[np.ndarray] = None
+    init_score: Optional[np.ndarray] = None
+
+    def set_group(self, group: Optional[np.ndarray]) -> None:
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).ravel()
+        self.query_boundaries = np.concatenate([[0], np.cumsum(group)])
+
+    def num_queries(self) -> int:
+        if self.query_boundaries is None:
+            return 0
+        return len(self.query_boundaries) - 1
+
+
+class Dataset:
+    """User-facing Dataset mirroring ``lightgbm.Dataset`` semantics.
+
+    Lazy construction: raw data is kept until ``construct()`` is called
+    (by ``train()``/``Booster``), at which point binning runs — matching
+    basic.py's ``Dataset._lazy_init``. A validation dataset created via
+    ``create_valid``/``reference=`` reuses the training set's BinMappers,
+    exactly as the reference requires aligned bin boundaries.
+    """
+
+    def __init__(self, data, label=None, reference: "Dataset" = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True):
+        self.data = data
+        self.params = dict(params or {})
+        self.reference = reference
+        self.free_raw_data = free_raw_data
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.metadata = Metadata()
+        if label is not None:
+            self.metadata.label = np.asarray(label, dtype=np.float64).ravel()
+        if weight is not None:
+            self.metadata.weight = np.asarray(weight,
+                                              dtype=np.float64).ravel()
+        if group is not None:
+            self.metadata.set_group(np.asarray(group))
+        if init_score is not None:
+            self.metadata.init_score = np.asarray(init_score,
+                                                  dtype=np.float64)
+        # filled by construct()
+        self._constructed = False
+        self.bin_mappers: List[BinMapper] = []
+        self.binned: Optional[np.ndarray] = None   # [n_rows, n_used]
+        self.used_features: List[int] = []         # original feature indices
+        self.num_total_features = 0
+        self.num_data = 0
+        self._raw_for_linear: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _to_matrix(data) -> np.ndarray:
+        """Accept numpy / pandas / list-of-lists / scipy-sparse."""
+        if hasattr(data, "toarray"):          # scipy sparse
+            return np.asarray(data.toarray(), dtype=np.float64)
+        if hasattr(data, "values") and hasattr(data, "columns"):  # pandas
+            return np.asarray(data.values, dtype=np.float64)
+        arr = np.asarray(data, dtype=np.float64)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        return arr
+
+    def _resolve_feature_names(self, n_features: int) -> List[str]:
+        if isinstance(self.feature_name, list):
+            return list(self.feature_name)
+        if hasattr(self.data, "columns"):     # pandas
+            return [str(c) for c in self.data.columns]
+        return [f"Column_{i}" for i in range(n_features)]
+
+    def _resolve_categorical(self, names: List[str]) -> List[int]:
+        cf = self.categorical_feature
+        if cf == "auto" or cf is None:
+            # pandas category dtype auto-detection
+            if hasattr(self.data, "dtypes"):
+                return [i for i, dt in enumerate(self.data.dtypes)
+                        if str(dt) == "category"]
+            return []
+        out = []
+        for c in cf:
+            if isinstance(c, str):
+                if c in names:
+                    out.append(names.index(c))
+                else:
+                    log.warning(f"categorical_feature {c} not in data")
+            else:
+                out.append(int(c))
+        return out
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._constructed:
+            return self
+        X = self._to_matrix(self.data)
+        self.num_data, self.num_total_features = X.shape
+        if self.metadata.label is not None \
+                and len(self.metadata.label) != self.num_data:
+            log.fatal(f"Length of label ({len(self.metadata.label)}) does "
+                      f"not match number of data ({self.num_data})")
+        if self.metadata.weight is not None \
+                and len(self.metadata.weight) != self.num_data:
+            log.fatal(f"Length of weight ({len(self.metadata.weight)}) "
+                      f"does not match number of data ({self.num_data})")
+        names = self._resolve_feature_names(self.num_total_features)
+        self.feature_names = names
+        cat_idx = self._resolve_categorical(names)
+        self.categorical_idx = cat_idx
+
+        if self.reference is not None:
+            ref = self.reference.construct()
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.feature_names = ref.feature_names
+            self.categorical_idx = ref.categorical_idx
+        else:
+            p = self.params
+            self.bin_mappers = find_bin_mappers(
+                X,
+                max_bin=int(p.get("max_bin", 255)),
+                min_data_in_bin=int(p.get("min_data_in_bin", 3)),
+                sample_cnt=int(p.get("bin_construct_sample_cnt", 200000)),
+                use_missing=bool(p.get("use_missing", True)),
+                zero_as_missing=bool(p.get("zero_as_missing", False)),
+                categorical_features=cat_idx,
+                max_bin_by_feature=p.get("max_bin_by_feature"),
+                seed=int(p.get("data_random_seed", 1)))
+            self.used_features = [i for i, m in enumerate(self.bin_mappers)
+                                  if not m.is_trivial]
+            if len(self.used_features) < self.num_total_features:
+                n_drop = self.num_total_features - len(self.used_features)
+                log.info(f"Dropped {n_drop} constant feature(s)")
+            if not self.used_features:
+                log.warning("There are no meaningful features which satisfy "
+                            "the provided configuration.")
+
+        max_num_bin = max((self.bin_mappers[f].num_bin
+                           for f in self.used_features), default=2)
+        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+        cols = []
+        for f in self.used_features:
+            cols.append(self.bin_mappers[f].values_to_bins(X[:, f])
+                        .astype(dtype))
+        self.binned = (np.stack(cols, axis=1) if cols
+                       else np.zeros((self.num_data, 0), dtype=dtype))
+        if bool(self.params.get("linear_tree", False)):
+            self._raw_for_linear = X[:, self.used_features].copy()
+        self._constructed = True
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, params=params,
+                       free_raw_data=self.free_raw_data)
+
+    def set_label(self, label) -> "Dataset":
+        self.metadata.label = np.asarray(label, dtype=np.float64).ravel()
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.metadata.weight = (None if weight is None else
+                                np.asarray(weight, dtype=np.float64).ravel())
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.metadata.set_group(None if group is None else np.asarray(group))
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.metadata.init_score = (None if init_score is None else
+                                    np.asarray(init_score, dtype=np.float64))
+        return self
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name == "group":
+            return self.set_group(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        log.fatal(f"Unknown field name {field_name}")
+
+    def get_field(self, field_name: str):
+        if field_name == "label":
+            return self.metadata.label
+        if field_name == "weight":
+            return self.metadata.weight
+        if field_name == "group":
+            return self.metadata.query_boundaries
+        if field_name == "init_score":
+            return self.metadata.init_score
+        log.fatal(f"Unknown field name {field_name}")
+
+    def get_label(self):
+        return self.metadata.label
+
+    def get_weight(self):
+        return self.metadata.weight
+
+    def get_group(self):
+        qb = self.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+    def get_init_score(self):
+        return self.metadata.init_score
+
+    def num_feature(self) -> int:
+        self.construct()
+        return len(self.used_features)
+
+    def num_data_(self) -> int:
+        self.construct()
+        return self.num_data
+
+    def __len__(self) -> int:
+        if self._constructed:
+            return self.num_data
+        return len(self._to_matrix(self.data))
+
+    # ------------------------------------------------------------------
+    def subset(self, used_indices: Sequence[int],
+               params: Optional[Dict[str, Any]] = None) -> "Dataset":
+        """Row-subset sharing this dataset's bin mappers (for cv folds)."""
+        self.construct()
+        idx = np.asarray(used_indices, dtype=np.int64)
+        sub = Dataset.__new__(Dataset)
+        sub.data = None
+        sub.params = dict(params or self.params)
+        sub.reference = self
+        sub.free_raw_data = self.free_raw_data
+        sub.feature_name = self.feature_name
+        sub.categorical_feature = self.categorical_feature
+        sub.metadata = Metadata()
+        md = self.metadata
+        if md.label is not None:
+            sub.metadata.label = md.label[idx]
+        if md.weight is not None:
+            sub.metadata.weight = md.weight[idx]
+        if md.init_score is not None:
+            sub.metadata.init_score = np.asarray(md.init_score)[idx]
+        if md.query_boundaries is not None:
+            # rebuild query boundaries from per-row query ids; assumes idx
+            # keeps whole queries together (cv's group-aware folds do)
+            qid = np.searchsorted(md.query_boundaries, idx,
+                                  side="right") - 1
+            change = np.flatnonzero(np.diff(qid)) + 1
+            counts = np.diff(np.concatenate([[0], change, [len(idx)]]))
+            sub.metadata.set_group(counts)
+        sub._constructed = True
+        sub.bin_mappers = self.bin_mappers
+        sub.binned = self.binned[idx]
+        sub.used_features = self.used_features
+        sub.feature_names = self.feature_names
+        sub.categorical_idx = self.categorical_idx
+        sub.num_total_features = self.num_total_features
+        sub.num_data = len(idx)
+        sub._raw_for_linear = (None if self._raw_for_linear is None
+                               else self._raw_for_linear[idx])
+        return sub
+
+    # ------------------------------------------------------------------
+    def feature_num_bins(self) -> np.ndarray:
+        """num_bin per used feature (padded arrays for the jit learner)."""
+        self.construct()
+        return np.array([self.bin_mappers[f].num_bin
+                         for f in self.used_features], dtype=np.int32)

@@ -1,0 +1,310 @@
+"""Serial (single-device) leaf-wise tree learner.
+
+Reference: ``SerialTreeLearner::Train`` (src/treelearner/serial_tree_learner
+.cpp, UNVERIFIED — empty mount, see SURVEY.md banner): best-first growth —
+repeat ``num_leaves - 1`` times: construct the smaller new leaf's
+histogram, derive the sibling by SUBTRACTION from the parent, find each
+leaf's best split, expand the globally best leaf, partition its rows.
+
+TPU-first design (SURVEY.md §7.1):
+- The reference's ``DataPartition`` per-leaf index buckets become a per-row
+  ``leaf_id`` vector; splitting a leaf is a masked ``where`` update — no
+  dynamic shapes.
+- The whole growth loop is ONE ``lax.while_loop`` inside jit; tree
+  structure lives in fixed-size flat arrays exactly like the reference's
+  ``Tree`` (left/right child, ``~leaf`` encoding for leaf children).
+- The histogram pool (``HistogramPool`` LRU in the reference) becomes a
+  dense ``[num_leaves, F, B, 3]`` array — every active leaf's histogram is
+  retained so sibling subtraction is a slice. For very wide datasets this
+  trades memory for simplicity; a pooled variant can come later.
+- Leaf-membership masking makes each histogram a full-data scan; the
+  subtraction trick still halves the work. A partition-gather variant
+  (contiguous row slices per leaf, as the reference keeps) is the planned
+  optimization once correctness is locked.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.histogram import build_histogram
+from ..ops.split import (NEG_INF, SplitConfig, calc_leaf_output,
+                         find_best_split)
+
+
+@dataclasses.dataclass(frozen=True)
+class GrowConfig:
+    """Static tree-growth hyperparameters."""
+
+    num_leaves: int = 31
+    max_depth: int = -1
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    num_bins: int = 256
+    rows_per_block: int = 1024
+    precise_histogram: bool = False
+    # mesh axis to reduce histograms over (data-parallel learner): rows are
+    # sharded across this axis and every histogram / leaf-sum becomes a
+    # psum — the TPU-native replacement for the reference's ReduceScatter
+    # over sockets (data_parallel_tree_learner.cpp, SURVEY.md §3.4)
+    axis_name: str = ""
+
+    @property
+    def split_config(self) -> SplitConfig:
+        return SplitConfig(
+            lambda_l1=self.lambda_l1, lambda_l2=self.lambda_l2,
+            min_data_in_leaf=self.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.min_gain_to_split,
+            max_delta_step=self.max_delta_step)
+
+
+class GrowState(NamedTuple):
+    """while_loop carry for one tree's growth."""
+
+    split_idx: jnp.ndarray          # i: next internal node index
+    num_leaves: jnp.ndarray         # leaves allocated so far
+    has_split: jnp.ndarray          # any valid split pending?
+    leaf_id: jnp.ndarray            # [n] int32 per-row leaf assignment
+    leaf_hist: jnp.ndarray          # [L, F, B, 3]
+    leaf_sums: jnp.ndarray          # [L, 3] (grad, hess, count)
+    leaf_depth: jnp.ndarray         # [L]
+    best_gain: jnp.ndarray          # [L]
+    best_feature: jnp.ndarray       # [L]
+    best_threshold: jnp.ndarray     # [L]
+    best_default_left: jnp.ndarray  # [L] bool
+    best_left_sums: jnp.ndarray     # [L, 3]
+    best_right_sums: jnp.ndarray    # [L, 3]
+    # tree structure (mirrors Tree's flat arrays, src/io/tree.cpp)
+    split_feature: jnp.ndarray      # [L-1]
+    threshold_bin: jnp.ndarray      # [L-1]
+    default_left: jnp.ndarray       # [L-1] bool
+    left_child: jnp.ndarray         # [L-1] (node idx, or ~leaf if < 0)
+    right_child: jnp.ndarray        # [L-1]
+    split_gain: jnp.ndarray         # [L-1]
+    internal_value: jnp.ndarray     # [L-1]
+    internal_count: jnp.ndarray     # [L-1]
+    leaf_value: jnp.ndarray         # [L]
+    leaf_count: jnp.ndarray         # [L]
+    leaf_weight: jnp.ndarray        # [L]  (sum_hess)
+    leaf_parent: jnp.ndarray        # [L]
+    leaf_is_left: jnp.ndarray       # [L] bool
+
+
+def _masked_gains(state_gain, leaf_depth, num_leaves, max_depth):
+    L = state_gain.shape[0]
+    active = jnp.arange(L, dtype=jnp.int32) < num_leaves
+    gains = jnp.where(active, state_gain, NEG_INF)
+    if max_depth > 0:
+        gains = jnp.where(leaf_depth < max_depth, gains, NEG_INF)
+    return gains
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def grow_tree(bins: jax.Array, vals: jax.Array, feat_num_bin: jax.Array,
+              feat_has_nan: jax.Array, allowed_feature: jax.Array,
+              cfg: GrowConfig) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """Grow one leaf-wise tree.
+
+    Args:
+      bins: ``[n_rows, F]`` uint8/16 binned matrix (row count must be a
+        multiple of ``cfg.rows_per_block``; pad rows carry zero vals).
+      vals: ``[n_rows, 3]`` float32 (grad*mask, hess*mask, mask).
+      feat_num_bin / feat_has_nan: ``[F]`` per-feature bin metadata.
+      allowed_feature: ``[F]`` bool feature-sampling mask for this tree.
+      cfg: static growth config.
+
+    Returns:
+      (tree dict of fixed-size arrays + ``num_leaves`` actually used,
+       per-row ``leaf_id``).
+    """
+    n_rows, F = bins.shape
+    L = cfg.num_leaves
+    B = cfg.num_bins
+    scfg = cfg.split_config
+
+    def hist_fn(v):
+        h = build_histogram(bins, v, num_bins=B,
+                            rows_per_block=cfg.rows_per_block,
+                            precise=cfg.precise_histogram)
+        if cfg.axis_name:
+            h = jax.lax.psum(h, cfg.axis_name)
+        return h
+
+    def best_fn(hist, sums):
+        return find_best_split(hist, sums, feat_num_bin, feat_has_nan,
+                               allowed_feature, scfg)
+
+    root_hist = hist_fn(vals)
+    root_sums = jnp.sum(vals, axis=0)
+    if cfg.axis_name:
+        root_sums = jax.lax.psum(root_sums, cfg.axis_name)
+    root_best = best_fn(root_hist, root_sums)
+
+    def set0(arr, value):
+        return arr.at[0].set(value)
+
+    i32 = jnp.int32
+    state = GrowState(
+        split_idx=jnp.array(0, i32),
+        num_leaves=jnp.array(1, i32),
+        has_split=jnp.isfinite(root_best["gain"]),
+        leaf_id=jnp.zeros(n_rows, dtype=i32),
+        leaf_hist=set0(jnp.zeros((L, F, B, 3), jnp.float32), root_hist),
+        leaf_sums=set0(jnp.zeros((L, 3), jnp.float32), root_sums),
+        leaf_depth=jnp.zeros(L, i32),
+        best_gain=set0(jnp.full(L, NEG_INF), root_best["gain"]),
+        best_feature=set0(jnp.zeros(L, i32), root_best["feature"]),
+        best_threshold=set0(jnp.zeros(L, i32), root_best["threshold_bin"]),
+        best_default_left=set0(jnp.zeros(L, jnp.bool_),
+                               root_best["default_left"]),
+        best_left_sums=set0(jnp.zeros((L, 3), jnp.float32),
+                            root_best["left_sums"]),
+        best_right_sums=set0(jnp.zeros((L, 3), jnp.float32),
+                             root_best["right_sums"]),
+        split_feature=jnp.zeros(max(L - 1, 1), i32),
+        threshold_bin=jnp.zeros(max(L - 1, 1), i32),
+        default_left=jnp.zeros(max(L - 1, 1), jnp.bool_),
+        left_child=jnp.zeros(max(L - 1, 1), i32),
+        right_child=jnp.zeros(max(L - 1, 1), i32),
+        split_gain=jnp.zeros(max(L - 1, 1), jnp.float32),
+        internal_value=jnp.zeros(max(L - 1, 1), jnp.float32),
+        internal_count=jnp.zeros(max(L - 1, 1), jnp.float32),
+        leaf_value=set0(jnp.zeros(L, jnp.float32),
+                        calc_leaf_output(root_sums[0], root_sums[1],
+                                         cfg.lambda_l1, cfg.lambda_l2,
+                                         cfg.max_delta_step)),
+        leaf_count=set0(jnp.zeros(L, jnp.float32), root_sums[2]),
+        leaf_weight=set0(jnp.zeros(L, jnp.float32), root_sums[1]),
+        leaf_parent=jnp.full(L, -1, i32),
+        leaf_is_left=jnp.zeros(L, jnp.bool_),
+    )
+
+    def cond(s: GrowState):
+        return (s.split_idx < L - 1) & s.has_split
+
+    def body(s: GrowState) -> GrowState:
+        gains = _masked_gains(s.best_gain, s.leaf_depth, s.num_leaves,
+                              cfg.max_depth)
+        best_leaf = jnp.argmax(gains).astype(i32)
+        gain = gains[best_leaf]
+        node = s.split_idx
+        new_leaf = s.num_leaves
+
+        feature = s.best_feature[best_leaf]
+        tbin = s.best_threshold[best_leaf]
+        dleft = s.best_default_left[best_leaf]
+        lsums = s.best_left_sums[best_leaf]
+        rsums = s.best_right_sums[best_leaf]
+
+        # ---- partition: update per-row leaf ids (DataPartition::Split) ----
+        col = jnp.take(bins, feature, axis=1).astype(i32)
+        is_missing = feat_has_nan[feature] & (col == feat_num_bin[feature] - 1)
+        goes_left = jnp.where(is_missing, dleft, col <= tbin)
+        in_leaf = s.leaf_id == best_leaf
+        leaf_id = jnp.where(in_leaf & ~goes_left, new_leaf, s.leaf_id)
+
+        # ---- histograms: build smaller child, subtract for sibling -------
+        left_smaller = lsums[2] <= rsums[2]
+        smaller_leaf = jnp.where(left_smaller, best_leaf, new_leaf)
+        small_mask = (leaf_id == smaller_leaf).astype(jnp.float32)
+        small_hist = hist_fn(vals * small_mask[:, None])
+        parent_hist = s.leaf_hist[best_leaf]
+        large_hist = parent_hist - small_hist
+        left_hist = jnp.where(left_smaller, small_hist, large_hist)
+        right_hist = jnp.where(left_smaller, large_hist, small_hist)
+        leaf_hist = (s.leaf_hist.at[best_leaf].set(left_hist)
+                     .at[new_leaf].set(right_hist))
+
+        # ---- new best splits for both children ---------------------------
+        bl = best_fn(left_hist, lsums)
+        br = best_fn(right_hist, rsums)
+
+        def upd2(arr, v_left, v_right):
+            return arr.at[best_leaf].set(v_left).at[new_leaf].set(v_right)
+
+        psums = s.leaf_sums[best_leaf]
+        depth = s.leaf_depth[best_leaf] + 1
+
+        # ---- tree wiring (Tree::Split) -----------------------------------
+        p = s.leaf_parent[best_leaf]
+        p_safe = jnp.maximum(p, 0)
+        was_left = s.leaf_is_left[best_leaf]
+        lc = jnp.where(
+            (p >= 0) & was_left, s.left_child.at[p_safe].set(node),
+            s.left_child)
+        rc = jnp.where(
+            (p >= 0) & ~was_left, s.right_child.at[p_safe].set(node),
+            s.right_child)
+        lc = lc.at[node].set(-best_leaf - 1)     # ~leaf encoding
+        rc = rc.at[node].set(-new_leaf - 1)
+
+        lval = calc_leaf_output(lsums[0], lsums[1], cfg.lambda_l1,
+                                cfg.lambda_l2, cfg.max_delta_step)
+        rval = calc_leaf_output(rsums[0], rsums[1], cfg.lambda_l1,
+                                cfg.lambda_l2, cfg.max_delta_step)
+
+        new = GrowState(
+            split_idx=node + 1,
+            num_leaves=new_leaf + 1,
+            has_split=jnp.array(True),  # recomputed below
+            leaf_id=leaf_id,
+            leaf_hist=leaf_hist,
+            leaf_sums=upd2(s.leaf_sums, lsums, rsums),
+            leaf_depth=upd2(s.leaf_depth, depth, depth),
+            best_gain=upd2(s.best_gain, bl["gain"], br["gain"]),
+            best_feature=upd2(s.best_feature, bl["feature"], br["feature"]),
+            best_threshold=upd2(s.best_threshold, bl["threshold_bin"],
+                                br["threshold_bin"]),
+            best_default_left=upd2(s.best_default_left, bl["default_left"],
+                                   br["default_left"]),
+            best_left_sums=upd2(s.best_left_sums, bl["left_sums"],
+                                br["left_sums"]),
+            best_right_sums=upd2(s.best_right_sums, bl["right_sums"],
+                                 br["right_sums"]),
+            split_feature=s.split_feature.at[node].set(feature),
+            threshold_bin=s.threshold_bin.at[node].set(tbin),
+            default_left=s.default_left.at[node].set(dleft),
+            left_child=lc,
+            right_child=rc,
+            split_gain=s.split_gain.at[node].set(gain),
+            internal_value=s.internal_value.at[node].set(
+                calc_leaf_output(psums[0], psums[1], cfg.lambda_l1,
+                                 cfg.lambda_l2, cfg.max_delta_step)),
+            internal_count=s.internal_count.at[node].set(psums[2]),
+            leaf_value=upd2(s.leaf_value, lval, rval),
+            leaf_count=upd2(s.leaf_count, lsums[2], rsums[2]),
+            leaf_weight=upd2(s.leaf_weight, lsums[1], rsums[1]),
+            leaf_parent=upd2(s.leaf_parent, node, node),
+            leaf_is_left=upd2(s.leaf_is_left, jnp.array(True),
+                              jnp.array(False)),
+        )
+        next_gains = _masked_gains(new.best_gain, new.leaf_depth,
+                                   new.num_leaves, cfg.max_depth)
+        return new._replace(has_split=jnp.isfinite(jnp.max(next_gains)))
+
+    final = jax.lax.while_loop(cond, body, state)
+
+    tree = {
+        "num_leaves": final.num_leaves,
+        "split_feature": final.split_feature,
+        "threshold_bin": final.threshold_bin,
+        "default_left": final.default_left,
+        "left_child": final.left_child,
+        "right_child": final.right_child,
+        "split_gain": final.split_gain,
+        "internal_value": final.internal_value,
+        "internal_count": final.internal_count,
+        "leaf_value": final.leaf_value,
+        "leaf_count": final.leaf_count,
+        "leaf_weight": final.leaf_weight,
+    }
+    return tree, final.leaf_id

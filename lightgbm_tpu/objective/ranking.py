@@ -1,0 +1,210 @@
+"""Learning-to-rank objectives: LambdaRank and rank_xendcg.
+
+Reference: src/objective/rank_objective.hpp (UNVERIFIED — empty mount, see
+SURVEY.md banner): LambdaRank = NDCG-delta-weighted pairwise logistic
+lambdas with truncation at ``lambdarank_truncation_level`` (pairs must
+involve a top-T-by-score doc), optional per-query norm; rank_xendcg = the
+listwise cross-entropy surrogate with per-iteration random gammas.
+
+TPU-first: the reference's per-query dynamic pair loops become dense
+padded tensors — queries padded to a common length M, pairs shaped
+``[T, M]`` per query (exactly the truncated pair set), vmapped over a
+query batch and scanned over batches. Sorting replaces the reference's
+per-query index sorts; everything is fixed-shape under jit.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import Objective
+from ..utils import log
+
+
+def _pad_queries(query_boundaries: np.ndarray) -> Tuple[np.ndarray,
+                                                        np.ndarray, int]:
+    """Build a padded [Q, M] row-index matrix (-1 padding)."""
+    qb = np.asarray(query_boundaries, dtype=np.int64)
+    counts = np.diff(qb)
+    M = int(counts.max())
+    Q = len(counts)
+    idx = np.full((Q, M), -1, dtype=np.int32)
+    for q in range(Q):
+        idx[q, :counts[q]] = np.arange(qb[q], qb[q + 1])
+    return idx, counts, M
+
+
+class _RankingBase(Objective):
+    is_ranking = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._qidx = None       # [Q, M] padded row indices
+        self._qmask = None      # [Q, M] validity
+        self._n_rows = 0
+
+    def setup_queries(self, query_boundaries: np.ndarray,
+                      n_rows: int) -> None:
+        if query_boundaries is None:
+            log.fatal("Ranking objective requires query/group information")
+        idx, counts, M = _pad_queries(query_boundaries)
+        self._qidx = jnp.asarray(idx)
+        self._qmask = jnp.asarray(idx >= 0)
+        self._n_rows = n_rows
+        self._label_gain_table = None
+
+    def _gather_queries(self, arr):
+        safe = jnp.maximum(self._qidx, 0)
+        return arr[safe]
+
+
+class LambdaRank(_RankingBase):
+    name = "lambdarank"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = config.sigmoid
+        self.truncation = config.lambdarank_truncation_level
+        self.norm = config.lambdarank_norm
+
+    def prepare(self, label: np.ndarray, weight) -> None:
+        max_label = int(label.max())
+        if self.config.label_gain:
+            gains = np.asarray(self.config.label_gain, dtype=np.float64)
+        else:
+            gains = (2.0 ** np.arange(max(max_label + 1, 1))) - 1.0
+        self._gains_np = gains
+        self._label_gain_table = jnp.asarray(gains, jnp.float32)
+
+    def get_gradients(self, score, label, weight):
+        if self._qidx is None:
+            log.fatal("setup_queries was not called for lambdarank")
+        Q, M = self._qidx.shape
+        T = min(self.truncation, M)
+        sig = self.sigmoid
+        gains_tbl = self._label_gain_table
+
+        s = jnp.where(self._qmask, self._gather_queries(score), -jnp.inf)
+        y = jnp.where(self._qmask,
+                      self._gather_queries(label).astype(jnp.int32), -1)
+
+        def per_query(sq, yq, maskq):
+            # score-descending order (ties broken by index, like a stable
+            # sort on the reference side)
+            order = jnp.argsort(-sq, stable=True)          # [M]
+            s_sorted = sq[order]
+            y_sorted = yq[order]
+            valid_sorted = maskq[order]
+            g_sorted = jnp.where(valid_sorted,
+                                 gains_tbl[jnp.maximum(y_sorted, 0)], 0.0)
+            disc = 1.0 / jnp.log2(jnp.arange(M, dtype=jnp.float32) + 2.0)
+            # max DCG at truncation level over ideal (label-sorted) order
+            ideal = jnp.sort(g_sorted)[::-1]
+            maxdcg = jnp.sum(ideal[:T] * disc[:T])
+            inv_maxdcg = jnp.where(maxdcg > 0, 1.0 / maxdcg, 0.0)
+
+            # pair tensor: i in [0, T) (by score rank), j in [0, M)
+            si = s_sorted[:T, None]
+            sj = s_sorted[None, :]
+            yi = y_sorted[:T, None]
+            yj = y_sorted[None, :]
+            gi = g_sorted[:T, None]
+            gj = g_sorted[None, :]
+            di = disc[:T, None]
+            dj = disc[None, :]
+            j_after_i = (jnp.arange(M)[None, :]
+                         > jnp.arange(T)[:, None])
+            pair_ok = (j_after_i & valid_sorted[None, :]
+                       & valid_sorted[:T, None] & (yi != yj))
+
+            # (high, low) by label within the pair
+            i_is_high = yi > yj
+            s_high = jnp.where(i_is_high, si, sj)
+            s_low = jnp.where(i_is_high, sj, si)
+            delta = (jnp.abs(gi - gj) * jnp.abs(di - dj) * inv_maxdcg)
+            rho = jax.nn.sigmoid(-sig * (s_high - s_low))  # P(wrong order)
+            lam = sig * rho * delta                         # magnitude
+            hess_pair = sig * sig * rho * (1.0 - rho) * delta
+            lam = jnp.where(pair_ok, lam, 0.0)
+            hess_pair = jnp.where(pair_ok, hess_pair, 0.0)
+
+            # accumulate: high doc gets -lam, low doc gets +lam
+            lam_i = jnp.where(i_is_high, -lam, lam)         # [T, M]
+            lam_j = -lam_i
+            grad_sorted = jnp.zeros(M, jnp.float32)
+            grad_sorted = grad_sorted.at[:T].add(jnp.sum(lam_i, axis=1))
+            grad_sorted = grad_sorted + jnp.sum(lam_j, axis=0)
+            hess_sorted = jnp.zeros(M, jnp.float32)
+            hess_sorted = hess_sorted.at[:T].add(jnp.sum(hess_pair, axis=1))
+            hess_sorted = hess_sorted + jnp.sum(hess_pair, axis=0)
+
+            if self.norm:
+                sum_lam = jnp.sum(jnp.abs(lam))
+                norm_factor = jnp.where(
+                    sum_lam > 0, jnp.log2(1.0 + sum_lam) / sum_lam, 1.0)
+                grad_sorted = grad_sorted * norm_factor
+                hess_sorted = hess_sorted * norm_factor
+
+            # undo the sort
+            grad_q = jnp.zeros(M, jnp.float32).at[order].set(grad_sorted)
+            hess_q = jnp.zeros(M, jnp.float32).at[order].set(hess_sorted)
+            return grad_q, hess_q
+
+        grad_q, hess_q = jax.vmap(per_query)(s, y, self._qmask)
+
+        grad = jnp.zeros(score.shape[0], jnp.float32)
+        hess = jnp.zeros(score.shape[0], jnp.float32)
+        safe = jnp.maximum(self._qidx, 0)
+        gq = jnp.where(self._qmask, grad_q, 0.0)
+        hq = jnp.where(self._qmask, hess_q, 0.0)
+        grad = grad.at[safe.ravel()].add(gq.ravel())
+        hess = hess.at[safe.ravel()].add(hq.ravel())
+        if weight is not None:
+            grad = grad * weight
+            hess = hess * weight
+        return grad, hess
+
+
+class RankXENDCG(_RankingBase):
+    name = "rank_xendcg"
+    needs_rng = True  # per-iteration gammas; key is a step argument so it
+    # is NOT baked into the jit trace
+
+    def __init__(self, config):
+        super().__init__(config)
+
+    def prepare(self, label: np.ndarray, weight) -> None:
+        pass
+
+    def get_gradients(self, score, label, weight, key=None):
+        if self._qidx is None:
+            log.fatal("setup_queries was not called for rank_xendcg")
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        Q, M = self._qidx.shape
+        s = jnp.where(self._qmask, self._gather_queries(score), -jnp.inf)
+        y = jnp.where(self._qmask, self._gather_queries(label), 0.0)
+        gammas = jax.random.uniform(key, (Q, M))
+
+        rho = jax.nn.softmax(s, axis=1)                  # padded -> 0
+        phi = jnp.where(self._qmask, (2.0 ** y) - 1.0 + gammas, 0.0)
+        denom = jnp.sum(phi, axis=1, keepdims=True)
+        p = phi / jnp.maximum(denom, 1e-20)
+        grad_q = jnp.where(self._qmask, rho - p, 0.0)
+        hess_q = jnp.where(self._qmask, rho * (1.0 - rho), 0.0)
+        hess_q = jnp.maximum(hess_q, 1e-16)
+
+        grad = jnp.zeros(score.shape[0], jnp.float32)
+        hess = jnp.zeros(score.shape[0], jnp.float32)
+        safe = jnp.maximum(self._qidx, 0)
+        grad = grad.at[safe.ravel()].add(
+            jnp.where(self._qmask, grad_q, 0.0).ravel())
+        hess = hess.at[safe.ravel()].add(
+            jnp.where(self._qmask, hess_q, 0.0).ravel())
+        if weight is not None:
+            grad = grad * weight
+            hess = hess * weight
+        return grad, hess

@@ -1,0 +1,88 @@
+"""Histogram construction — the inner hot loop of GBDT training.
+
+Reference: ``Bin::ConstructHistogram`` (src/io/dense_bin.hpp, UNVERIFIED —
+empty mount, see SURVEY.md banner): for every row in a leaf,
+``hist[bin] += (grad, hess)`` — an 8-way unrolled scalar gather-add on CPU,
+a shared-memory atomic-add kernel on CUDA
+(src/treelearner/cuda/cuda_histogram_constructor.cu, UNVERIFIED).
+
+TPU-first design: TPUs have no fast scatter-add, but they have the MXU.
+The scatter becomes a ONE-HOT MATMUL: for a block of R rows,
+
+    contrib[f, b, c] = sum_r onehot(bin[r, f] == b) * vals[r, c]
+
+which is a single ``[F*B, R] x [R, C]`` matmul per block, accumulated in
+float32 over a ``lax.scan`` of row blocks. The one-hot is generated inline
+(iota-compare) so XLA fuses it into the matmul operand load — no
+materialized one-hot in HBM. Channels ``C = (grad, hess, count)``; row
+masking (leaf membership / bagging) is folded into ``vals`` by the caller,
+so a leaf histogram is a masked full scan. Inputs are cast to bfloat16
+(exact for the 0/1 one-hot and the count channel; ~8-bit mantissa for
+grad/hess — cf. the reference's int8 quantized-gradient mode,
+cuda_gradient_discretizer.cu) with float32 MXU accumulation.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "rows_per_block",
+                                             "precise"))
+def build_histogram(bins: jax.Array, vals: jax.Array, *, num_bins: int,
+                    rows_per_block: int = 1024,
+                    precise: bool = False) -> jax.Array:
+    """Compute ``hist[f, b, c] = sum_r [bins[r, f] == b] * vals[r, c]``.
+
+    Args:
+      bins: ``[n_rows, n_features]`` integer bin matrix (uint8/uint16).
+        ``n_rows`` must be a multiple of ``rows_per_block`` (pad with rows
+        whose ``vals`` are zero).
+      vals: ``[n_rows, n_channels]`` float32 per-row values, already
+        multiplied by any row mask.
+      num_bins: static histogram width ``B`` (>= max bin value + 1).
+      rows_per_block: scan block size; bounds the transient one-hot to
+        ``R * F * B`` bf16 elements so it stays VMEM-resident when fused.
+      precise: use float32 operands (slower) instead of bfloat16.
+
+    Returns:
+      ``[n_features, num_bins, n_channels]`` float32 histogram.
+    """
+    n_rows, n_features = bins.shape
+    n_channels = vals.shape[1]
+    assert n_rows % rows_per_block == 0, (
+        f"n_rows={n_rows} must be a multiple of rows_per_block="
+        f"{rows_per_block}; pad the dataset")
+    n_blocks = n_rows // rows_per_block
+    dtype = jnp.float32 if precise else jnp.bfloat16
+
+    bins_b = bins.reshape(n_blocks, rows_per_block, n_features)
+    vals_b = vals.reshape(n_blocks, rows_per_block, n_channels).astype(dtype)
+    iota = jnp.arange(num_bins, dtype=jnp.int32)
+
+    def body(acc, block):
+        bblock, vblock = block
+        # [R, F, B] one-hot, generated inline (fuses into the matmul)
+        onehot = (bblock.astype(jnp.int32)[:, :, None]
+                  == iota[None, None, :]).astype(dtype)
+        contrib = jnp.einsum(
+            "rfb,rc->fbc", onehot, vblock,
+            preferred_element_type=jnp.float32,
+            precision=(jax.lax.Precision.HIGHEST if precise
+                       else jax.lax.Precision.DEFAULT))
+        return acc + contrib, None
+
+    init = jnp.zeros((n_features, num_bins, n_channels), dtype=jnp.float32)
+    hist, _ = jax.lax.scan(body, init, (bins_b, vals_b))
+    return hist
+
+
+def pad_rows(n_rows: int, rows_per_block: int) -> int:
+    """Padded row count so the scan covers the data in whole blocks."""
+    return _round_up(max(n_rows, rows_per_block), rows_per_block)

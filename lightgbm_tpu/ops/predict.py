@@ -1,0 +1,86 @@
+"""Device-side tree traversal for prediction / score updates.
+
+Reference: ``GBDT::PredictRaw`` + ``Tree::Predict`` (src/boosting/
+gbdt_prediction.cpp, src/io/tree.cpp, UNVERIFIED — empty mount, see
+SURVEY.md banner): per-row node walk by threshold comparisons.
+
+TPU-first: all rows traverse in lockstep — a ``while_loop`` over tree
+depth where each step gathers (feature, threshold, children) for every
+row's current node and advances; rows that reached a leaf (negative node
+encoding) freeze. Trees stack along a leading axis and are folded with
+``lax.scan``, so predicting a whole model is one jitted program.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_predict_binned(tree: Dict[str, jax.Array], bins: jax.Array,
+                        feat_num_bin: jax.Array,
+                        feat_has_nan: jax.Array) -> Tuple[jax.Array,
+                                                          jax.Array]:
+    """Route every row of ``bins`` through one tree.
+
+    Args:
+      tree: dict of flat tree arrays (device), as produced by grow_tree.
+      bins: ``[n, F]`` binned features.
+
+    Returns:
+      (leaf_value per row ``[n]`` float32, leaf index per row ``[n]`` int32)
+    """
+    n = bins.shape[0]
+    num_leaves = tree["num_leaves"]
+    # node >= 0: internal node index; node < 0: ~leaf
+    node0 = jnp.where(num_leaves > 1, jnp.zeros(n, jnp.int32),
+                      jnp.full(n, -1, jnp.int32))
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        nd = jnp.maximum(node, 0)
+        feat = tree["split_feature"][nd]
+        thr = tree["threshold_bin"][nd]
+        dleft = tree["default_left"][nd]
+        col = jnp.take_along_axis(bins, feat[:, None].astype(jnp.int32),
+                                  axis=1)[:, 0].astype(jnp.int32)
+        missing = feat_has_nan[feat] & (col == feat_num_bin[feat] - 1)
+        go_left = jnp.where(missing, dleft, col <= thr)
+        nxt = jnp.where(go_left, tree["left_child"][nd],
+                        tree["right_child"][nd])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node0)
+    leaf = (-node - 1).astype(jnp.int32)
+    return tree["leaf_value"][leaf], leaf
+
+
+def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
+                          feat_num_bin: jax.Array, feat_has_nan: jax.Array,
+                          class_index: jax.Array,
+                          num_class: int) -> Tuple[jax.Array, jax.Array]:
+    """Sum leaf outputs of a stacked forest into per-class raw scores.
+
+    Args:
+      stacked: tree arrays with a leading ``[T]`` axis (trees padded to a
+        common ``num_leaves`` capacity).
+      class_index: ``[T]`` int32 — class each tree contributes to
+        (``t % num_class`` for multiclass round-robin, zeros for K=1).
+
+    Returns:
+      (raw scores ``[n, num_class]``, leaf indices ``[T, n]``)
+    """
+    n = bins.shape[0]
+
+    def body(carry, xs):
+        tree, cls = xs
+        vals, leaf = tree_predict_binned(tree, bins, feat_num_bin,
+                                         feat_has_nan)
+        return carry.at[:, cls].add(vals), leaf
+
+    init = jnp.zeros((n, num_class), jnp.float32)
+    scores, leaves = jax.lax.scan(body, init, (stacked, class_index))
+    return scores, leaves

@@ -1,0 +1,157 @@
+"""TreeSHAP feature contributions (predict_contrib).
+
+Reference: ``Tree::PredictContrib`` / TreeSHAP recursion (src/io/tree.cpp
+`TreeSHAP` + include/LightGBM/tree.h PathElement, UNVERIFIED — empty
+mount, see SURVEY.md banner). Implements the Lundberg & Lee
+path-dependent TreeSHAP: exact Shapley values under the tree's own
+cover distribution; last output column is the expected value (bias).
+
+Host-side NumPy: contributions are an explanation path, not a training
+hot loop. A batched device formulation can come later if profiling
+demands it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Path:
+    """Decision path state: parallel arrays of (feature, zero, one, w)."""
+
+    __slots__ = ("feature", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, depth_cap: int):
+        self.feature = np.zeros(depth_cap, dtype=np.int64)
+        self.zero_fraction = np.zeros(depth_cap, dtype=np.float64)
+        self.one_fraction = np.zeros(depth_cap, dtype=np.float64)
+        self.pweight = np.zeros(depth_cap, dtype=np.float64)
+
+    def copy(self, length: int) -> "_Path":
+        p = _Path(len(self.feature))
+        p.feature[:length] = self.feature[:length]
+        p.zero_fraction[:length] = self.zero_fraction[:length]
+        p.one_fraction[:length] = self.one_fraction[:length]
+        p.pweight[:length] = self.pweight[:length]
+        return p
+
+
+def _extend(p: _Path, length: int, zero: float, one: float,
+            feat: int) -> int:
+    p.feature[length] = feat
+    p.zero_fraction[length] = zero
+    p.one_fraction[length] = one
+    p.pweight[length] = 1.0 if length == 0 else 0.0
+    for i in range(length - 1, -1, -1):
+        p.pweight[i + 1] += one * p.pweight[i] * (i + 1) / (length + 1)
+        p.pweight[i] = zero * p.pweight[i] * (length - i) / (length + 1)
+    return length + 1
+
+
+def _unwind(p: _Path, length: int, idx: int) -> int:
+    length -= 1
+    one = p.one_fraction[idx]
+    zero = p.zero_fraction[idx]
+    n = p.pweight[length]
+    for i in range(length - 1, -1, -1):
+        if one != 0.0:
+            t = p.pweight[i]
+            p.pweight[i] = n * (length + 1) / ((i + 1) * one)
+            n = t - p.pweight[i] * zero * (length - i) / (length + 1)
+        else:
+            p.pweight[i] = p.pweight[i] * (length + 1) / (
+                zero * (length - i))
+    for i in range(idx, length):
+        p.feature[i] = p.feature[i + 1]
+        p.zero_fraction[i] = p.zero_fraction[i + 1]
+        p.one_fraction[i] = p.one_fraction[i + 1]
+    return length
+
+
+def _unwound_sum(p: _Path, length: int, idx: int) -> float:
+    one = p.one_fraction[idx]
+    zero = p.zero_fraction[idx]
+    total = 0.0
+    n = p.pweight[length - 1]
+    for i in range(length - 2, -1, -1):
+        if one != 0.0:
+            t = n * length / ((i + 1) * one)
+            total += t
+            n = p.pweight[i] - t * zero * (length - 1 - i) / length
+        else:
+            total += p.pweight[i] * length / (zero * (length - 1 - i))
+    return total
+
+
+def _node_cover(tree, node: int) -> float:
+    if node < 0:
+        return float(tree.leaf_count[-node - 1])
+    return float(tree.internal_count[node])
+
+
+def _tree_shap_row(tree, x: np.ndarray, phi: np.ndarray) -> None:
+    max_depth = int(tree.leaf_depths().max()) + 2 if tree.num_leaves > 1 \
+        else 1
+
+    def recurse(node: int, p: _Path, length: int, zero: float, one: float,
+                feat: int) -> None:
+        length = _extend(p, length, zero, one, feat)
+        if node < 0:  # leaf
+            leaf_val = float(tree.leaf_value[-node - 1])
+            for i in range(1, length):
+                w = _unwound_sum(p, length, i)
+                phi[p.feature[i]] += w * (p.one_fraction[i]
+                                          - p.zero_fraction[i]) * leaf_val
+            return
+        f = int(tree.split_feature[node])
+        v = x[f]
+        thr = tree.threshold_real[node]
+        if np.isnan(v):
+            go_left = bool(tree.default_left[node])
+        else:
+            go_left = v <= thr
+        hot = int(tree.left_child[node] if go_left
+                  else tree.right_child[node])
+        cold = int(tree.right_child[node] if go_left
+                   else tree.left_child[node])
+        cover = _node_cover(tree, node)
+        hot_r = _node_cover(tree, hot) / cover if cover > 0 else 0.0
+        cold_r = _node_cover(tree, cold) / cover if cover > 0 else 0.0
+        iz, io = 1.0, 1.0
+        k = -1
+        for i in range(1, length):
+            if p.feature[i] == f:
+                k = i
+                break
+        if k >= 0:
+            iz = p.zero_fraction[k]
+            io = p.one_fraction[k]
+            length = _unwind(p, length, k)
+        recurse(hot, p.copy(length), length, iz * hot_r, io, f)
+        recurse(cold, p.copy(length), length, iz * cold_r, 0.0, f)
+
+    if tree.num_leaves <= 1:
+        return
+    recurse(0, _Path(max_depth + 2), 0, 1.0, 1.0, -1)
+
+
+def tree_shap_batch(tree, X: np.ndarray, n_feat: int) -> np.ndarray:
+    """SHAP contributions for one tree over a batch.
+
+    Returns ``[n, n_feat + 1]``; the last column is the tree's expected
+    value (bias term).
+    """
+    n = X.shape[0]
+    out = np.zeros((n, n_feat + 1), dtype=np.float64)
+    if tree.num_leaves <= 1:
+        out[:, -1] = tree.leaf_value[0] if len(tree.leaf_value) else 0.0
+        return out
+    total = float(tree.leaf_count.sum())
+    expected = float(np.sum(tree.leaf_value[:tree.num_leaves]
+                            * tree.leaf_count[:tree.num_leaves]) / total) \
+        if total > 0 else 0.0
+    for r in range(n):
+        phi = np.zeros(n_feat + 1, dtype=np.float64)
+        _tree_shap_row(tree, X[r], phi)
+        out[r, :n_feat] = phi[:n_feat]
+        out[r, -1] = expected
+    return out

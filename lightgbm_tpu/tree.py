@@ -1,0 +1,141 @@
+"""Host-side tree model: flat-array binary tree.
+
+Reference: ``Tree`` (include/LightGBM/tree.h, src/io/tree.cpp, UNVERIFIED —
+empty mount, see SURVEY.md banner): internal nodes in arrays of size
+``num_leaves-1`` (split_feature, threshold, left/right child with ``~leaf``
+encoding), leaves in arrays of size ``num_leaves``; both bin thresholds and
+real-valued thresholds kept so prediction works on raw features.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Tree:
+    """One trained tree (host numpy; device stacking happens in predict)."""
+
+    num_leaves: int
+    split_feature: np.ndarray    # [num_leaves-1] int32 (used-feature index)
+    threshold_bin: np.ndarray    # [num_leaves-1] int32
+    threshold_real: np.ndarray   # [num_leaves-1] float64
+    default_left: np.ndarray     # [num_leaves-1] bool
+    left_child: np.ndarray       # [num_leaves-1] int32 (~leaf if negative)
+    right_child: np.ndarray      # [num_leaves-1] int32
+    split_gain: np.ndarray       # [num_leaves-1] float32
+    internal_value: np.ndarray   # [num_leaves-1] float32
+    internal_count: np.ndarray   # [num_leaves-1] int64
+    leaf_value: np.ndarray       # [num_leaves] float64 (shrinkage applied)
+    leaf_count: np.ndarray       # [num_leaves] int64
+    leaf_weight: np.ndarray      # [num_leaves] float64
+    shrinkage: float = 1.0
+    # categorical split support (filled when cat splits exist)
+    cat_boundaries: Optional[np.ndarray] = None
+    cat_threshold: Optional[np.ndarray] = None
+    is_categorical: Optional[np.ndarray] = None
+
+    @property
+    def num_nodes(self) -> int:
+        return max(self.num_leaves - 1, 0)
+
+    def shrink(self, rate: float) -> None:
+        """Tree::Shrinkage — scale leaf outputs."""
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        self.shrinkage *= rate
+
+    # ------------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """Predict on raw feature values (used features only)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0] if len(self.leaf_value)
+                           else 0.0)
+        leaf = self._leaf_index_raw(X)
+        return self.leaf_value[leaf]
+
+    def _leaf_index_raw(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        node = np.zeros(n, dtype=np.int64)
+        active = np.ones(n, dtype=bool) if self.num_leaves > 1 else \
+            np.zeros(n, dtype=bool)
+        out = np.zeros(n, dtype=np.int64)
+        for _ in range(self.num_nodes + 1):
+            if not active.any():
+                break
+            nd = node[active]
+            feat = self.split_feature[nd]
+            vals = X[active, feat]
+            thr = self.threshold_real[nd]
+            dl = self.default_left[nd]
+            miss = np.isnan(vals)
+            go_left = np.where(miss, dl, vals <= thr)
+            nxt = np.where(go_left, self.left_child[nd],
+                           self.right_child[nd])
+            at_leaf = nxt < 0
+            idx = np.flatnonzero(active)
+            out[idx[at_leaf]] = -nxt[at_leaf] - 1
+            node[idx] = np.maximum(nxt, 0)
+            new_active = active.copy()
+            new_active[idx[at_leaf]] = False
+            active = new_active
+        return out
+
+    def predict_leaf_raw(self, X: np.ndarray) -> np.ndarray:
+        if self.num_leaves <= 1:
+            return np.zeros(X.shape[0], dtype=np.int64)
+        return self._leaf_index_raw(X)
+
+    def leaf_depths(self) -> np.ndarray:
+        """Depth of each leaf (for model text's leaf_depth field)."""
+        depth = np.zeros(self.num_leaves, dtype=np.int64)
+        if self.num_leaves <= 1:
+            return depth
+        node_depth = np.zeros(self.num_nodes, dtype=np.int64)
+        for nd in range(self.num_nodes):
+            for child in (self.left_child[nd], self.right_child[nd]):
+                if child >= 0:
+                    node_depth[child] = node_depth[nd] + 1
+                else:
+                    depth[-child - 1] = node_depth[nd] + 1
+        return depth
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_device(tree_arrays: Dict[str, np.ndarray], shrinkage: float,
+                    bin_mappers, used_features: List[int]) -> "Tree":
+        """Build from grow_tree's device output (already on host)."""
+        nl = int(tree_arrays["num_leaves"])
+        nn = max(nl - 1, 0)
+        sf = np.asarray(tree_arrays["split_feature"])[:nn].astype(np.int32)
+        tb = np.asarray(tree_arrays["threshold_bin"])[:nn].astype(np.int32)
+        tr = np.zeros(nn, dtype=np.float64)
+        for i in range(nn):
+            mapper = bin_mappers[used_features[int(sf[i])]]
+            tr[i] = mapper.bin_to_threshold(int(tb[i]))
+        t = Tree(
+            num_leaves=nl,
+            split_feature=sf,
+            threshold_bin=tb,
+            threshold_real=tr,
+            default_left=np.asarray(tree_arrays["default_left"])[:nn],
+            left_child=np.asarray(tree_arrays["left_child"])[:nn]
+            .astype(np.int32),
+            right_child=np.asarray(tree_arrays["right_child"])[:nn]
+            .astype(np.int32),
+            split_gain=np.asarray(tree_arrays["split_gain"])[:nn],
+            internal_value=np.asarray(tree_arrays["internal_value"])[:nn],
+            internal_count=np.asarray(tree_arrays["internal_count"])[:nn]
+            .astype(np.int64),
+            leaf_value=np.asarray(tree_arrays["leaf_value"])[:nl]
+            .astype(np.float64),
+            leaf_count=np.asarray(tree_arrays["leaf_count"])[:nl]
+            .astype(np.int64),
+            leaf_weight=np.asarray(tree_arrays["leaf_weight"])[:nl]
+            .astype(np.float64),
+        )
+        t.shrink(shrinkage)
+        return t

@@ -1,0 +1,29 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+This is the "multi-node without a cluster" mechanism (SURVEY.md §4): the
+reference spawns N localhost CLI processes for its distributed tests; we
+give XLA 8 fake host devices so sharded/distributed paths execute real
+collectives in-process.
+
+NOTE: this environment's site config pins ``jax_platforms=axon,cpu`` (one
+real TPU via tunnel), so JAX_PLATFORMS env alone is ignored — we must
+override through jax.config BEFORE any device is initialized.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# persistent compilation cache: grow_tree's while_loop is expensive to
+# compile; cache across test runs keeps the suite fast
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/lightgbm_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"expected 8 fake CPU devices, got {jax.devices()}")

@@ -1,0 +1,117 @@
+"""BinMapper oracle tests (reference behavior: src/io/bin.cpp)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import (BIN_TYPE_CATEGORICAL, BinMapper,
+                                     MISSING_NAN, MISSING_NONE,
+                                     find_bin_mappers)
+
+
+def test_uniform_bins_cover_all_values():
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=10000)
+    m = BinMapper.from_sample(v, len(v), max_bin=255)
+    bins = m.values_to_bins(v)
+    assert bins.min() >= 0
+    assert bins.max() < m.num_bin
+    # bins are monotone in value
+    order = np.argsort(v)
+    assert np.all(np.diff(bins[order]) >= 0)
+
+
+def test_bin_counts_roughly_equal():
+    rng = np.random.default_rng(1)
+    v = rng.uniform(size=100000)
+    m = BinMapper.from_sample(v, len(v), max_bin=64)
+    bins = m.values_to_bins(v)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    nonzero = counts[counts > 0]
+    # greedy equal-mass binning: no bin more than ~4x the mean
+    assert nonzero.max() < 4 * nonzero.mean()
+    assert m.num_bin <= 64
+
+
+def test_distinct_values_get_own_bins():
+    v = np.repeat([1.0, 2.0, 5.0, 9.0], 100)
+    m = BinMapper.from_sample(v, len(v), max_bin=255, min_data_in_bin=3)
+    bins = m.values_to_bins(np.array([1.0, 2.0, 5.0, 9.0]))
+    assert len(set(bins.tolist())) == 4
+
+
+def test_zero_gets_own_bin():
+    rng = np.random.default_rng(2)
+    v = np.where(rng.uniform(size=5000) < 0.5, 0.0,
+                 rng.normal(size=5000))
+    m = BinMapper.from_sample(v, len(v), max_bin=32)
+    zb = m.value_to_bin(0.0)
+    # tiny values on either side of zero bin separate from it
+    assert m.value_to_bin(-0.5) < zb or m.value_to_bin(0.5) > zb
+    bins = m.values_to_bins(v)
+    zero_rows = np.abs(v) <= 1e-35
+    assert np.all(bins[zero_rows] == zb)
+
+
+def test_nan_bin_is_last():
+    rng = np.random.default_rng(3)
+    v = rng.normal(size=1000)
+    v[::7] = np.nan
+    m = BinMapper.from_sample(v, len(v), max_bin=32)
+    assert m.missing_type == MISSING_NAN
+    bins = m.values_to_bins(v)
+    assert np.all(bins[::7] == m.num_bin - 1)
+    assert np.all(bins[~np.isnan(v)] < m.num_bin - 1)
+
+
+def test_no_nan_no_nan_bin():
+    v = np.arange(100, dtype=np.float64)
+    m = BinMapper.from_sample(v, len(v), max_bin=300, min_data_in_bin=1)
+    assert m.missing_type == MISSING_NONE
+
+
+def test_trivial_constant_feature():
+    v = np.full(100, 3.0)
+    m = BinMapper.from_sample(v, len(v), max_bin=32)
+    assert m.is_trivial
+
+
+def test_categorical_mapping():
+    rng = np.random.default_rng(4)
+    v = rng.choice([2, 5, 7, 11], size=1000,
+                   p=[0.5, 0.3, 0.15, 0.05]).astype(np.float64)
+    m = BinMapper.from_sample(v, len(v), max_bin=32, is_categorical=True)
+    assert m.bin_type == BIN_TYPE_CATEGORICAL
+    bins = m.values_to_bins(v)
+    # most frequent category gets bin 1
+    assert m.cat_to_bin[2] == 1
+    assert np.all(bins > 0)
+    # unseen category and nan -> bin 0
+    assert m.values_to_bins(np.array([999.0]))[0] == 0
+    assert m.values_to_bins(np.array([np.nan]))[0] == 0
+
+
+def test_categorical_rare_tail_pruned():
+    rng = np.random.default_rng(5)
+    common = rng.choice([1, 2, 3], size=990).astype(np.float64)
+    rare = np.arange(100, 110, dtype=np.float64)
+    v = np.concatenate([common, rare])
+    m = BinMapper.from_sample(v, len(v), max_bin=256, is_categorical=True)
+    # 99% mass cut prunes the singleton tail
+    assert m.num_bin <= 5
+
+
+def test_find_bin_mappers_sampling():
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(5000, 3))
+    mappers = find_bin_mappers(X, max_bin=64, sample_cnt=1000, seed=7)
+    assert len(mappers) == 3
+    for m in mappers:
+        assert 2 <= m.num_bin <= 64
+
+
+def test_max_bin_by_feature():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(2000, 2))
+    mappers = find_bin_mappers(X, max_bin=64,
+                               max_bin_by_feature=[8, 0])
+    assert mappers[0].num_bin <= 8
+    assert mappers[1].num_bin <= 64

@@ -1,0 +1,211 @@
+"""End-to-end training tests (mirrors reference test_engine.py style:
+metric-threshold assertions on synthetic data)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=4000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    logit = X @ w + 0.5 * X[:, 0] * X[:, 1]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _regression_data(n=4000, f=10, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = X @ w + np.sin(2 * X[:, 0]) + rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+def test_binary_auc_threshold():
+    X, y = _binary_data()
+    ds = lgb.Dataset(X[:3000], label=y[:3000])
+    vs = ds.create_valid(X[3000:], label=y[3000:])
+    res = {}
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "metric": "auc",
+         "verbosity": -1}, ds, num_boost_round=30, valid_sets=[vs],
+        callbacks=[lgb.record_evaluation(res)])
+    auc = res["valid_0"]["auc"][-1]
+    assert auc > 0.92
+    # AUC improves over training
+    assert res["valid_0"]["auc"][-1] > res["valid_0"]["auc"][0]
+
+
+def test_regression_l2_threshold():
+    X, y = _regression_data()
+    ds = lgb.Dataset(X[:3000], label=y[:3000])
+    vs = ds.create_valid(X[3000:], label=y[3000:])
+    res = {}
+    lgb.train({"objective": "regression", "num_leaves": 31,
+               "metric": "l2", "verbosity": -1}, ds, num_boost_round=50,
+              valid_sets=[vs], callbacks=[lgb.record_evaluation(res)])
+    l2 = res["valid_0"]["l2"]
+    assert l2[-1] < l2[0] * 0.3
+    assert l2[-1] < np.var(y) * 0.3
+
+
+def test_predict_matches_eval_score():
+    X, y = _binary_data(n=2000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=10)
+    pred = bst.predict(X)
+    raw = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, 1.0 / (1.0 + np.exp(-raw)), rtol=1e-5)
+    assert pred.shape == (2000,)
+    assert np.all((pred >= 0) & (pred <= 1))
+
+
+def test_early_stopping():
+    X, y = _binary_data()
+    ds = lgb.Dataset(X[:3000], label=y[:3000])
+    vs = ds.create_valid(X[3000:], label=y[3000:])
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 127, "metric": "auc",
+         "verbosity": -1, "early_stopping_round": 3, "learning_rate": 0.5},
+        ds, num_boost_round=200, valid_sets=[vs])
+    assert bst.best_iteration < 200
+    assert bst.num_trees() <= 200
+
+
+def test_multiclass():
+    rng = np.random.default_rng(7)
+    n = 3000
+    X = rng.normal(size=(n, 8))
+    y = (np.abs(X[:, 0]) + np.abs(X[:, 1]) * 2).astype(np.int64) % 3
+    ds = lgb.Dataset(X[:2000], label=y[:2000])
+    vs = ds.create_valid(X[2000:], label=y[2000:])
+    res = {}
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbosity": -1},
+                    ds, num_boost_round=20, valid_sets=[vs],
+                    callbacks=[lgb.record_evaluation(res)])
+    pred = bst.predict(X[2000:])
+    assert pred.shape == (1000, 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-4)
+    acc = (np.argmax(pred, axis=1) == y[2000:]).mean()
+    assert acc > 0.55
+    assert res["valid_0"]["multi_logloss"][-1] < np.log(3)
+
+
+def test_feature_importance():
+    X, y = _regression_data(n=2000, f=6)
+    # make feature 0 dominant
+    y = y + 5 * X[:, 0]
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=10)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.shape == (6,)
+    assert imp_gain.argmax() == 0
+    assert imp_split.sum() > 0
+
+
+def test_bagging_and_feature_fraction():
+    X, y = _binary_data(n=3000)
+    ds = lgb.Dataset(X[:2000], label=y[:2000])
+    vs = ds.create_valid(X[2000:], label=y[2000:])
+    res = {}
+    lgb.train({"objective": "binary", "num_leaves": 31, "metric": "auc",
+               "bagging_fraction": 0.5, "bagging_freq": 1,
+               "feature_fraction": 0.7, "verbosity": -1},
+              ds, num_boost_round=30, valid_sets=[vs],
+              callbacks=[lgb.record_evaluation(res)])
+    assert res["valid_0"]["auc"][-1] > 0.88
+
+
+def test_weights_respected():
+    rng = np.random.default_rng(9)
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    # weight only the first half; second half labels are flipped noise
+    y[n // 2:] = 1 - y[n // 2:]
+    w = np.concatenate([np.ones(n // 2), np.zeros(n // 2) + 1e-6])
+    ds = lgb.Dataset(X, label=y, weight=w)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "min_sum_hessian_in_leaf": 1e-9},
+                    ds, num_boost_round=10)
+    pred = bst.predict(X[:n // 2])
+    acc = ((pred > 0.5) == (y[:n // 2] > 0)).mean()
+    assert acc > 0.95
+
+
+def test_rollback_one_iter():
+    X, y = _binary_data(n=1000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=5)
+    assert bst.num_trees() == 5
+    bst.rollback_one_iter()
+    assert bst.num_trees() == 4
+
+
+def test_regression_l1_and_huber_objectives():
+    X, y = _regression_data(n=2000)
+    for obj in ("regression_l1", "huber", "fair"):
+        ds = lgb.Dataset(X[:1500], label=y[:1500])
+        vs = ds.create_valid(X[1500:], label=y[1500:])
+        res = {}
+        lgb.train({"objective": obj, "num_leaves": 15, "metric": "l1",
+                   "verbosity": -1}, ds, num_boost_round=40,
+                  valid_sets=[vs], callbacks=[lgb.record_evaluation(res)])
+        l1 = res["valid_0"]["l1"]
+        assert l1[-1] < l1[0], obj
+
+
+def test_poisson_objective():
+    rng = np.random.default_rng(11)
+    n = 2000
+    X = rng.normal(size=(n, 5))
+    lam = np.exp(0.5 * X[:, 0] - 0.3 * X[:, 1])
+    y = rng.poisson(lam).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "poisson", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=30)
+    pred = bst.predict(X)
+    assert np.all(pred > 0)
+    assert np.corrcoef(pred, lam)[0, 1] > 0.7
+
+
+def test_cv():
+    X, y = _binary_data(n=1500)
+    ds = lgb.Dataset(X, label=y)
+    res = lgb.cv({"objective": "binary", "num_leaves": 15, "metric": "auc",
+                  "verbosity": -1}, ds, num_boost_round=10, nfold=3)
+    assert len(res["valid auc-mean"]) == 10
+    assert res["valid auc-mean"][-1] > 0.85
+
+
+def test_init_score_offset():
+    X, y = _regression_data(n=1000)
+    init = np.full(len(y), 100.0)
+    ds = lgb.Dataset(X, label=y + 100.0, init_score=init)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "boost_from_average": False, "verbosity": -1},
+                    ds, num_boost_round=20)
+    # prediction does not include user init_score (reference semantics),
+    # so preds approximate y (the residual target)
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < np.var(y)
+
+
+def test_nan_handling():
+    X, y = _binary_data(n=2000)
+    X = X.copy()
+    X[::5, 0] = np.nan
+    ds = lgb.Dataset(X[:1500], label=y[:1500])
+    vs = ds.create_valid(X[1500:], label=y[1500:])
+    res = {}
+    lgb.train({"objective": "binary", "num_leaves": 15, "metric": "auc",
+               "verbosity": -1}, ds, num_boost_round=20, valid_sets=[vs],
+              callbacks=[lgb.record_evaluation(res)])
+    assert res["valid_0"]["auc"][-1] > 0.85

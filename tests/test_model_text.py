@@ -1,0 +1,89 @@
+"""Model text serialization round-trip + SHAP contributions."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train_binary(n=2000, f=6, rounds=10, **extra):
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    params.update(extra)
+    ds = lgb.Dataset(X, label=y)
+    return lgb.train(params, ds, num_boost_round=rounds), X, y
+
+
+def test_roundtrip_predictions_match():
+    bst, X, y = _train_binary()
+    s = bst.model_to_string()
+    assert s.startswith("tree")
+    assert "version=v4" in s
+    assert "end of trees" in s
+    bst2 = lgb.Booster(model_str=s)
+    p1 = bst.predict(X)
+    p2 = bst2.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_roundtrip_via_file(tmp_path):
+    bst, X, _ = _train_binary(rounds=5)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        bst.predict(X, raw_score=True), bst2.predict(X, raw_score=True),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_model_text_regression():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1000, 4))
+    y = X @ rng.normal(size=4)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=8)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_model_text_multiclass():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(1500, 5))
+    y = (np.abs(X[:, 0]) * 2 + np.abs(X[:, 1])).astype(np.int64) % 3
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    ds, num_boost_round=5)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pred_leaf():
+    bst, X, _ = _train_binary(rounds=5)
+    leaves = bst.predict(X[:50], pred_leaf=True)
+    assert leaves.shape == (50, 5)
+    assert leaves.dtype == np.int32
+    assert leaves.max() < 15
+
+
+def test_shap_sums_to_prediction():
+    bst, X, _ = _train_binary(rounds=5)
+    contrib = bst.predict(X[:30], pred_contrib=True)
+    raw = bst.predict(X[:30], raw_score=True)
+    assert contrib.shape == (30, X.shape[1] + 1)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_feature_importance_in_model_text():
+    bst, X, _ = _train_binary(rounds=5)
+    s = bst.model_to_string()
+    assert "feature_importances:" in s
+    assert "Column_0=" in s

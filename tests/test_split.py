@@ -1,0 +1,133 @@
+"""Split finder vs brute-force NumPy oracle."""
+import numpy as np
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.split import (SplitConfig, calc_leaf_output,
+                                    find_best_split, leaf_gain)
+
+
+def _oracle_best(hist, num_bin, has_nan, cfg):
+    """Brute force over (feature, threshold, direction)."""
+    F, B, _ = hist.shape
+    parent = hist[0].sum(axis=0)  # any feature's bins sum to the totals
+    def lg(g, h):
+        t = np.sign(g) * max(abs(g) - cfg.lambda_l1, 0.0) \
+            if cfg.lambda_l1 > 0 else g
+        return t * t / (h + cfg.lambda_l2) if h + cfg.lambda_l2 > 0 else 0.0
+    pg = lg(parent[0], parent[1])
+    best = (-np.inf, None)
+    for f in range(F):
+        nb = num_bin[f]
+        nv = nb - (1 if has_nan[f] else 0)
+        nan_vals = hist[f, nb - 1] if has_nan[f] else np.zeros(3)
+        for t in range(nv - 1 + (1 if has_nan[f] else 0)):
+            base_left = hist[f, :t + 1].sum(axis=0)
+            if has_nan[f] and t >= nb - 1:
+                continue
+            for dl in (False, True):
+                left = base_left + (nan_vals if dl else 0)
+                right = parent - left
+                if left[2] < cfg.min_data_in_leaf or \
+                        right[2] < cfg.min_data_in_leaf:
+                    continue
+                if left[1] < cfg.min_sum_hessian_in_leaf or \
+                        right[1] < cfg.min_sum_hessian_in_leaf:
+                    continue
+                gain = lg(left[0], left[1]) + lg(right[0], right[1]) - pg
+                if gain > cfg.min_gain_to_split and gain > best[0]:
+                    best = (gain, (f, t, dl))
+    return best
+
+
+def _random_case(seed, F=5, B=16, l1=0.0, l2=0.0, min_data=1):
+    rng = np.random.default_rng(seed)
+    num_bin = rng.integers(4, B + 1, size=F).astype(np.int32)
+    has_nan = rng.uniform(size=F) < 0.5
+    hist = np.zeros((F, B, 3), dtype=np.float64)
+    n = 500
+    g = rng.normal(size=n)
+    h = rng.uniform(0.1, 1.0, size=n)
+    for f in range(F):
+        b = rng.integers(0, num_bin[f], size=n)
+        np.add.at(hist[f], b, np.stack([g, h, np.ones(n)], axis=1))
+    cfg = SplitConfig(lambda_l1=l1, lambda_l2=l2, min_data_in_leaf=min_data,
+                      min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0)
+    return hist, num_bin, has_nan, cfg
+
+
+def test_matches_oracle():
+    for seed in range(8):
+        hist, num_bin, has_nan, cfg = _random_case(seed)
+        parent = hist[0].sum(axis=0)
+        res = find_best_split(
+            jnp.asarray(hist, jnp.float32), jnp.asarray(parent, jnp.float32),
+            jnp.asarray(num_bin), jnp.asarray(has_nan),
+            jnp.ones(len(num_bin), dtype=bool), cfg)
+        o_gain, o_split = _oracle_best(hist, num_bin, has_nan, cfg)
+        if o_split is None:
+            assert not np.isfinite(float(res["gain"]))
+            continue
+        np.testing.assert_allclose(float(res["gain"]), o_gain, rtol=1e-4)
+        # the chosen split must achieve the oracle gain (ties allowed)
+        f, t, dl = (int(res["feature"]), int(res["threshold_bin"]),
+                    bool(res["default_left"]))
+        # recompute gain of the returned split via oracle formula
+        hist2 = hist.copy()
+        nb = num_bin[f]
+        nan_vals = hist2[f, nb - 1] if has_nan[f] else np.zeros(3)
+        left = hist2[f, :t + 1].sum(axis=0)
+        if has_nan[f]:
+            left = left - (nan_vals if t >= nb - 1 else 0)
+            if dl:
+                left = left + nan_vals
+        right = hist2[0].sum(axis=0) - left
+        np.testing.assert_allclose(
+            float(res["left_sums"][2]), left[2], rtol=1e-5)
+
+
+def test_constraints_block_all():
+    hist, num_bin, has_nan, _ = _random_case(99)
+    cfg = SplitConfig(min_data_in_leaf=10**6)
+    parent = hist[0].sum(axis=0)
+    res = find_best_split(
+        jnp.asarray(hist, jnp.float32), jnp.asarray(parent, jnp.float32),
+        jnp.asarray(num_bin), jnp.asarray(has_nan),
+        jnp.ones(len(num_bin), dtype=bool), cfg)
+    assert not np.isfinite(float(res["gain"]))
+
+
+def test_feature_mask_respected():
+    hist, num_bin, has_nan, cfg = _random_case(3)
+    parent = hist[0].sum(axis=0)
+    allowed = np.zeros(len(num_bin), dtype=bool)
+    allowed[2] = True
+    res = find_best_split(
+        jnp.asarray(hist, jnp.float32), jnp.asarray(parent, jnp.float32),
+        jnp.asarray(num_bin), jnp.asarray(has_nan), jnp.asarray(allowed),
+        cfg)
+    if np.isfinite(float(res["gain"])):
+        assert int(res["feature"]) == 2
+
+
+def test_l1_l2_regularization_reduces_gain():
+    hist, num_bin, has_nan, cfg0 = _random_case(5)
+    parent = hist[0].sum(axis=0)
+    args = (jnp.asarray(hist, jnp.float32), jnp.asarray(parent, jnp.float32),
+            jnp.asarray(num_bin), jnp.asarray(has_nan),
+            jnp.ones(len(num_bin), dtype=bool))
+    g0 = float(find_best_split(*args, cfg0)["gain"])
+    g_l2 = float(find_best_split(
+        *args, SplitConfig(lambda_l2=10.0, min_data_in_leaf=1))["gain"])
+    assert g_l2 < g0
+
+
+def test_leaf_output_formula():
+    out = float(calc_leaf_output(jnp.float32(10.0), jnp.float32(5.0),
+                                 0.0, 1.0))
+    np.testing.assert_allclose(out, -10.0 / 6.0, rtol=1e-6)
+    out_l1 = float(calc_leaf_output(jnp.float32(10.0), jnp.float32(5.0),
+                                    2.0, 1.0))
+    np.testing.assert_allclose(out_l1, -8.0 / 6.0, rtol=1e-6)
+    out_clip = float(calc_leaf_output(jnp.float32(10.0), jnp.float32(5.0),
+                                      0.0, 0.0, max_delta_step=0.5))
+    np.testing.assert_allclose(out_clip, -0.5, rtol=1e-6)
