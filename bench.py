@@ -1,0 +1,87 @@
+"""Benchmark: boosting iters/sec on synthetic Higgs-1M-like data.
+
+Driver contract: print ONE JSON line
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Config mirrors BASELINE.json's flagship: binary classification, 28 dense
+features, num_leaves=127, max_bin=255. The dataset is synthesized (no
+network in this environment; Higgs itself is a download) at 1M rows —
+matching the "Higgs-1M CPU hist baseline" config shape.
+
+vs_baseline: BASELINE.md holds NO verified reference numbers (empty
+mount). We compare against 1.0 iters/sec — the ballpark of CPU
+hist-LightGBM on Higgs-1M-class data per BASELINE.md's unverified
+recollection table — so vs_baseline > 1 means faster than CPU LightGBM.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(1e6)
+N_FEATURES = 28
+NUM_LEAVES = 127
+MAX_BIN = 255
+WARMUP_ITERS = 3
+BENCH_ITERS = 10
+CPU_LIGHTGBM_BASELINE_ITERS_PER_SEC = 1.0  # UNVERIFIED, see BASELINE.md
+
+
+def synth_higgs(n, f, seed=0):
+    """Higgs-like: mixture of informative kinematic-ish features."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+    logit = (X @ w * 0.5 + 0.8 * X[:, 0] * X[:, 1]
+             + 0.5 * np.abs(X[:, 2]) - 0.4)
+    y = (logit + rng.normal(scale=1.0, size=n) > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def main():
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+
+    X, y = synth_higgs(N_ROWS, N_FEATURES)
+    t_bin = time.time()
+    ds = lgb.Dataset(X, label=y)
+    cfg = Config({"objective": "binary", "num_leaves": NUM_LEAVES,
+                  "max_bin": MAX_BIN, "learning_rate": 0.1,
+                  "verbosity": -1})
+    eng = GBDT(cfg, ds)
+    bin_time = time.time() - t_bin
+
+    # warmup (jit compile + cache)
+    for _ in range(WARMUP_ITERS):
+        eng.train_one_iter()
+    import jax
+    jax.block_until_ready(eng.score)
+
+    t0 = time.time()
+    for _ in range(BENCH_ITERS):
+        eng.train_one_iter()
+    jax.block_until_ready(eng.score)
+    dt = time.time() - t0
+    iters_per_sec = BENCH_ITERS / dt
+
+    # final train AUC as the quality guard
+    from lightgbm_tpu.metric import AUCMetric
+    pred = eng._convert_output_np(np.asarray(eng.score)[:eng.data.n])
+    auc = AUCMetric(cfg).eval(pred, y, None)[0][1]
+
+    result = {
+        "metric": ("boosting_iters_per_sec "
+                   f"(higgs1m-synth nl={NUM_LEAVES} mb={MAX_BIN}; "
+                   f"train_auc={auc:.4f}; binning_s={bin_time:.1f})"),
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(
+            iters_per_sec / CPU_LIGHTGBM_BASELINE_ITERS_PER_SEC, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

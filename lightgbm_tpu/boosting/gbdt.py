@@ -46,7 +46,8 @@ class _DeviceData:
     rounds up so every shard holds whole histogram blocks.
     """
 
-    def __init__(self, ds: Dataset, rows_per_block: int, mesh=None):
+    def __init__(self, ds: Dataset, rows_per_block: int, mesh=None,
+                 transposed: bool = False):
         ds.construct()
         self.n = ds.num_data
         n_shards = mesh.devices.size if mesh is not None else 1
@@ -57,7 +58,7 @@ class _DeviceData:
                            dtype=binned.dtype)
             binned = np.concatenate([binned, pad], axis=0)
 
-        from ..parallel.mesh import shard_rows
+        from ..parallel.mesh import NamedSharding, P, shard_rows
 
         def place(a, extra_dims=1):
             if mesh is None:
@@ -65,6 +66,15 @@ class _DeviceData:
             return shard_rows(mesh, np.asarray(a), extra_dims)
 
         self.bins = place(binned, extra_dims=2)
+        self.bins_t = None
+        if transposed:
+            # feature-major int8 copy for the Pallas histogram kernel
+            bt = np.ascontiguousarray(binned.T).astype(np.int8)
+            if mesh is None:
+                self.bins_t = jnp.asarray(bt)
+            else:
+                self.bins_t = jax.device_put(
+                    bt, NamedSharding(mesh, P(None, "data")))
         self._place = place
         md = ds.metadata
 
@@ -244,6 +254,43 @@ class GBDT:
             return grow_all(bins, score, g, h, mask_gh, mask_count,
                             allowed)
 
+        top_rate = float(self.config.top_rate)
+        other_rate = float(self.config.other_rate)
+
+        def goss_masks(g, h, valid_mask, key):
+            """GOSS (goss.hpp): keep top-a by |g*h|, sample b of the rest,
+            amplify the sampled rest by (1-a)/b. Per-shard under the mesh,
+            matching the reference's per-machine local bagging."""
+            metric = jnp.abs(g * h)
+            if K > 1:
+                metric = jnp.sum(metric, axis=1)
+            metric = metric * valid_mask
+            n_local = metric.shape[0]
+            n_valid = jnp.sum(valid_mask)
+            k_top = jnp.round(top_rate * n_valid).astype(jnp.int32)
+            k_rest = jnp.maximum(n_valid - k_top, 1.0)
+            k_rand = jnp.round(other_rate * n_valid)
+            sorted_m = jnp.sort(metric)
+            thresh_idx = jnp.clip(n_local - k_top, 0, n_local - 1)
+            thresh = sorted_m[thresh_idx]
+            is_top = (metric >= thresh) & (valid_mask > 0) & (k_top > 0)
+            rest = (valid_mask > 0) & ~is_top
+            p_pick = jnp.minimum(k_rand / k_rest, 1.0)
+            picked = rest & (jax.random.uniform(key, (n_local,)) < p_pick)
+            amp = (1.0 - top_rate) / max(other_rate, 1e-12)
+            mask_gh = (is_top.astype(jnp.float32)
+                       + picked.astype(jnp.float32) * amp)
+            mask_count = (is_top | picked).astype(jnp.float32)
+            return mask_gh, mask_count
+
+        def step_goss_impl(bins, label, weight, score, valid_mask,
+                           allowed, key):
+            kg, km = jax.random.split(key)
+            g, h = gradients(score, label, weight, kg)
+            mask_gh, mask_count = goss_masks(g, h, valid_mask, km)
+            return grow_all(bins, score, g, h, mask_gh, mask_count,
+                            allowed)
+
         def step_custom_impl(bins, score, g, h, mask_gh, mask_count,
                              allowed):
             return grow_all(bins, score, g, h, mask_gh, mask_count,
@@ -270,6 +317,11 @@ class GBDT:
             def step(score, mask_gh, mask_count, allowed, key):
                 return step_impl(d.bins, d.label, d.weight, score, mask_gh,
                                  mask_count, allowed, key)
+
+            @jax.jit
+            def step_goss(score, allowed, key):
+                return step_goss_impl(d.bins, d.label, d.weight, score,
+                                      d.valid_mask, allowed, key)
 
             @jax.jit
             def step_custom(score, g, h, mask_gh, mask_count, allowed):
@@ -305,6 +357,10 @@ class GBDT:
                 step_impl, mesh=mesh,
                 in_specs=(row2, row1, w_spec, row2, row1, row1, rep, rep),
                 out_specs=out_specs, check_vma=False)
+            sharded_goss = shard_map(
+                step_goss_impl, mesh=mesh,
+                in_specs=(row2, row1, w_spec, row2, row1, rep, rep),
+                out_specs=out_specs, check_vma=False)
             grad_spec = row2 if K > 1 else row1
             sharded_custom = shard_map(
                 step_custom_impl, mesh=mesh,
@@ -316,6 +372,11 @@ class GBDT:
             def step(score, mask_gh, mask_count, allowed, key):
                 return sharded_step(d.bins, d.label, d.weight, score,
                                     mask_gh, mask_count, allowed, key)
+
+            @jax.jit
+            def step_goss(score, allowed, key):
+                return sharded_goss(d.bins, d.label, d.weight, score,
+                                    d.valid_mask, allowed, key)
 
             @jax.jit
             def step_custom(score, g, h, mask_gh, mask_count, allowed):
@@ -346,6 +407,7 @@ class GBDT:
             return score
 
         self._step = step
+        self._step_goss = step_goss
         self._step_custom = step_custom
         self._valid_update = valid_update
         self._apply_renewed = apply_renewed
@@ -397,15 +459,24 @@ class GBDT:
                        hess: Optional[np.ndarray] = None) -> None:
         """One boosting iteration (optionally with custom fobj grads)."""
         allowed = self._feature_mask()
-        mask_gh, mask_count = self._bagging_masks()
+        key = jax.random.PRNGKey(self.config.objective_seed + self.iter_)
+        # GOSS kicks in after 1/learning_rate iterations (goss.hpp keeps
+        # the first iterations un-subsampled)
+        goss_active = (
+            self.config.data_sample_strategy == "goss" and grad is None
+            and self.iter_ >= int(1.0 / max(self.config.learning_rate,
+                                            1e-6)))
         if grad is not None:
+            mask_gh, mask_count = self._bagging_masks()
             g = self._pad_custom(grad)
             h = self._pad_custom(hess)
             stacked, leaf_ids, new_score = self._step_custom(
                 self.score, g, h, mask_gh, mask_count, allowed)
+        elif goss_active:
+            stacked, leaf_ids, new_score = self._step_goss(
+                self.score, allowed, key)
         else:
-            key = jax.random.PRNGKey(self.config.objective_seed
-                                     + self.iter_)
+            mask_gh, mask_count = self._bagging_masks()
             stacked, leaf_ids, new_score = self._step(
                 self.score, mask_gh, mask_count, allowed, key)
         # leaf-output renewal (L1/quantile/MAPE percentile re-fit,

@@ -232,6 +232,10 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "tpu_rows_per_block": _P("int", 4096),
     "tpu_mesh_shape": _P("str", ""),
     "tpu_double_precision_hist": _P("bool", False),
+    # leaves expanded per growth round; 1 = exact reference leaf-wise
+    # order, larger batches fuse K leaf histograms into one data scan
+    "tpu_leaf_batch": _P("int", 16, [], (1, 256)),
+    "tpu_use_pallas": _P("bool", True),
 }
 
 # alias -> canonical name

@@ -1,26 +1,31 @@
-"""Serial (single-device) leaf-wise tree learner.
+"""Leaf-wise tree learner: batched best-first growth under jit.
 
 Reference: ``SerialTreeLearner::Train`` (src/treelearner/serial_tree_learner
 .cpp, UNVERIFIED — empty mount, see SURVEY.md banner): best-first growth —
-repeat ``num_leaves - 1`` times: construct the smaller new leaf's
-histogram, derive the sibling by SUBTRACTION from the parent, find each
-leaf's best split, expand the globally best leaf, partition its rows.
+repeatedly construct the smaller new leaf's histogram, derive the sibling
+by SUBTRACTION from the parent, find per-leaf best splits, expand the best
+leaf, partition its rows.
 
 TPU-first design (SURVEY.md §7.1):
 - The reference's ``DataPartition`` per-leaf index buckets become a per-row
-  ``leaf_id`` vector; splitting a leaf is a masked ``where`` update — no
-  dynamic shapes.
-- The whole growth loop is ONE ``lax.while_loop`` inside jit; tree
-  structure lives in fixed-size flat arrays exactly like the reference's
-  ``Tree`` (left/right child, ``~leaf`` encoding for leaf children).
-- The histogram pool (``HistogramPool`` LRU in the reference) becomes a
-  dense ``[num_leaves, F, B, 3]`` array — every active leaf's histogram is
-  retained so sibling subtraction is a slice. For very wide datasets this
-  trades memory for simplicity; a pooled variant can come later.
-- Leaf-membership masking makes each histogram a full-data scan; the
-  subtraction trick still halves the work. A partition-gather variant
-  (contiguous row slices per leaf, as the reference keeps) is the planned
-  optimization once correctness is locked.
+  ``leaf_id`` vector; splitting is a masked ``where`` update — no dynamic
+  shapes.
+- The growth loop is ONE ``lax.while_loop``; tree structure lives in
+  fixed-size flat arrays exactly like the reference's ``Tree`` (~leaf child
+  encoding). Each array has one trailing TRASH slot so vectorized scatters
+  for inactive batch lanes are harmless.
+- BATCHED best-first: each round expands the top-``leaf_batch`` leaves at
+  once, and the Pallas kernel (ops/pallas_histogram.py) computes ALL their
+  smaller-child histograms in one fused data scan — the masks pack into
+  the matmul N dimension, amortizing both the scan and the MXU's N-padding.
+  ``leaf_batch=1`` reproduces the reference's exact leaf-wise order; larger
+  batches are a bounded relaxation (each round's choices are still the
+  current best leaves) trading exact split ORDER for ~10-20x fewer scans.
+- The histogram pool (``HistogramPool`` LRU) becomes a dense
+  ``[L+1, F, B, 3]`` array so sibling subtraction is a slice.
+- Data-parallel: with ``cfg.axis_name`` set, rows are sharded over that
+  mesh axis and every histogram/leaf-sum is psum'd — the TPU-native
+  replacement for the reference's socket ReduceScatter (SURVEY.md §3.4).
 """
 from __future__ import annotations
 
@@ -31,7 +36,8 @@ from typing import Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import build_histogram
+from ..ops.pallas_histogram import (multi_leaf_histogram,
+                                    multi_leaf_histogram_xla)
 from ..ops.split import (NEG_INF, SplitConfig, calc_leaf_output,
                          find_best_split)
 
@@ -51,10 +57,11 @@ class GrowConfig:
     num_bins: int = 256
     rows_per_block: int = 1024
     precise_histogram: bool = False
-    # mesh axis to reduce histograms over (data-parallel learner): rows are
-    # sharded across this axis and every histogram / leaf-sum becomes a
-    # psum — the TPU-native replacement for the reference's ReduceScatter
-    # over sockets (data_parallel_tree_learner.cpp, SURVEY.md §3.4)
+    # number of leaves expanded per round (1 = exact reference order)
+    leaf_batch: int = 1
+    # use the fused Pallas kernel (TPU) vs the XLA einsum fallback
+    use_pallas: bool = False
+    # mesh axis for data-parallel histogram reduction ("" = single device)
     axis_name: str = ""
 
     @property
@@ -68,82 +75,106 @@ class GrowConfig:
 
 
 class GrowState(NamedTuple):
-    """while_loop carry for one tree's growth."""
+    """while_loop carry. Leaf arrays sized L+1 (slot L = trash); node
+    arrays sized L (slot L-1 = trash; real nodes use 0..L-2)."""
 
-    split_idx: jnp.ndarray          # i: next internal node index
-    num_leaves: jnp.ndarray         # leaves allocated so far
-    has_split: jnp.ndarray          # any valid split pending?
-    leaf_id: jnp.ndarray            # [n] int32 per-row leaf assignment
-    leaf_hist: jnp.ndarray          # [L, F, B, 3]
-    leaf_sums: jnp.ndarray          # [L, 3] (grad, hess, count)
-    leaf_depth: jnp.ndarray         # [L]
-    best_gain: jnp.ndarray          # [L]
-    best_feature: jnp.ndarray       # [L]
-    best_threshold: jnp.ndarray     # [L]
-    best_default_left: jnp.ndarray  # [L] bool
-    best_left_sums: jnp.ndarray     # [L, 3]
-    best_right_sums: jnp.ndarray    # [L, 3]
-    # tree structure (mirrors Tree's flat arrays, src/io/tree.cpp)
-    split_feature: jnp.ndarray      # [L-1]
-    threshold_bin: jnp.ndarray      # [L-1]
-    default_left: jnp.ndarray       # [L-1] bool
-    left_child: jnp.ndarray         # [L-1] (node idx, or ~leaf if < 0)
-    right_child: jnp.ndarray        # [L-1]
-    split_gain: jnp.ndarray         # [L-1]
-    internal_value: jnp.ndarray     # [L-1]
-    internal_count: jnp.ndarray     # [L-1]
-    leaf_value: jnp.ndarray         # [L]
-    leaf_count: jnp.ndarray         # [L]
-    leaf_weight: jnp.ndarray        # [L]  (sum_hess)
-    leaf_parent: jnp.ndarray        # [L]
-    leaf_is_left: jnp.ndarray       # [L] bool
+    split_idx: jnp.ndarray
+    num_leaves: jnp.ndarray
+    has_split: jnp.ndarray
+    leaf_id: jnp.ndarray            # [n]
+    leaf_hist: jnp.ndarray          # [L+1, F, B, 3]
+    leaf_sums: jnp.ndarray          # [L+1, 3]
+    leaf_depth: jnp.ndarray         # [L+1]
+    best_gain: jnp.ndarray          # [L+1]
+    best_feature: jnp.ndarray
+    best_threshold: jnp.ndarray
+    best_default_left: jnp.ndarray
+    best_left_sums: jnp.ndarray     # [L+1, 3]
+    best_right_sums: jnp.ndarray
+    split_feature: jnp.ndarray      # [L]
+    threshold_bin: jnp.ndarray
+    default_left: jnp.ndarray
+    left_child: jnp.ndarray
+    right_child: jnp.ndarray
+    split_gain: jnp.ndarray
+    internal_value: jnp.ndarray
+    internal_count: jnp.ndarray
+    leaf_value: jnp.ndarray         # [L+1]
+    leaf_count: jnp.ndarray
+    leaf_weight: jnp.ndarray
+    leaf_parent: jnp.ndarray
+    leaf_is_left: jnp.ndarray
 
 
-def _masked_gains(state_gain, leaf_depth, num_leaves, max_depth):
-    L = state_gain.shape[0]
-    active = jnp.arange(L, dtype=jnp.int32) < num_leaves
-    gains = jnp.where(active, state_gain, NEG_INF)
+def _masked_gains(gain, leaf_depth, num_leaves, max_depth):
+    Lp1 = gain.shape[0]
+    active = jnp.arange(Lp1, dtype=jnp.int32) < num_leaves
+    gains = jnp.where(active, gain, NEG_INF)
     if max_depth > 0:
         gains = jnp.where(leaf_depth < max_depth, gains, NEG_INF)
     return gains
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def grow_tree(bins: jax.Array, vals: jax.Array, feat_num_bin: jax.Array,
-              feat_has_nan: jax.Array, allowed_feature: jax.Array,
+def grow_tree(bins: jax.Array, bins_t: jax.Array, vals: jax.Array,
+              feat_num_bin: jax.Array, feat_has_nan: jax.Array,
+              allowed_feature: jax.Array,
               cfg: GrowConfig) -> Tuple[Dict[str, jax.Array], jax.Array]:
-    """Grow one leaf-wise tree.
+    """Grow one tree.
 
     Args:
-      bins: ``[n_rows, F]`` uint8/16 binned matrix (row count must be a
-        multiple of ``cfg.rows_per_block``; pad rows carry zero vals).
-      vals: ``[n_rows, 3]`` float32 (grad*mask, hess*mask, mask).
+      bins: ``[n, F]`` row-major binned matrix (partition gathers).
+      bins_t: ``[F, n]`` int8 feature-major copy (Pallas kernel input;
+        ignored on the XLA fallback path).
+      vals: ``[n, 3]`` float32 (grad*mask, hess*mask, count-mask).
       feat_num_bin / feat_has_nan: ``[F]`` per-feature bin metadata.
       allowed_feature: ``[F]`` bool feature-sampling mask for this tree.
       cfg: static growth config.
 
     Returns:
-      (tree dict of fixed-size arrays + ``num_leaves`` actually used,
-       per-row ``leaf_id``).
+      (tree dict of fixed-size arrays + ``num_leaves``, per-row leaf_id).
     """
     n_rows, F = bins.shape
     L = cfg.num_leaves
     B = cfg.num_bins
+    Kb = max(1, min(cfg.leaf_batch, L))
+    i32 = jnp.int32
     scfg = cfg.split_config
 
-    def hist_fn(v):
-        h = build_histogram(bins, v, num_bins=B,
-                            rows_per_block=cfg.rows_per_block,
-                            precise=cfg.precise_histogram)
-        if cfg.axis_name:
-            h = jax.lax.psum(h, cfg.axis_name)
-        return h
+    if cfg.use_pallas:
+        vals_t = vals.T
+        pr = min(cfg.rows_per_block, 2048)
 
-    def best_fn(hist, sums):
-        return find_best_split(hist, sums, feat_num_bin, feat_has_nan,
-                               allowed_feature, scfg)
+        def hist_multi(leaf_id, small_ids):
+            h = multi_leaf_histogram(bins_t, vals_t, leaf_id, small_ids,
+                                     num_bins=B, rows_per_block=pr)
+            if cfg.axis_name:
+                h = jax.lax.psum(h, cfg.axis_name)
+            return h
+    else:
+        def hist_multi(leaf_id, small_ids):
+            h = multi_leaf_histogram_xla(bins, vals, leaf_id, small_ids,
+                                         num_bins=B,
+                                         rows_per_block=cfg.rows_per_block)
+            if cfg.axis_name:
+                h = jax.lax.psum(h, cfg.axis_name)
+            return h
 
-    root_hist = hist_fn(vals)
+    best_fn = functools.partial(
+        find_best_split, num_bin=feat_num_bin, has_nan=feat_has_nan,
+        allowed_feature=allowed_feature, cfg=scfg)
+    best_vfn = jax.vmap(lambda h, s: best_fn(h, s))
+
+    def leaf_out(sums):
+        return calc_leaf_output(sums[..., 0], sums[..., 1], cfg.lambda_l1,
+                                cfg.lambda_l2, cfg.max_delta_step)
+
+    # ---- root ----------------------------------------------------------
+    leaf_id0 = jnp.zeros(n_rows, dtype=i32)
+    root_small = jnp.concatenate(
+        [jnp.zeros(1, i32), jnp.full(Kb - 1, -1, i32)]) if Kb > 1 \
+        else jnp.zeros(1, i32)
+    root_hist = hist_multi(leaf_id0, root_small)[0]
     root_sums = jnp.sum(vals, axis=0)
     if cfg.axis_name:
         root_sums = jax.lax.psum(root_sums, cfg.axis_name)
@@ -152,41 +183,43 @@ def grow_tree(bins: jax.Array, vals: jax.Array, feat_num_bin: jax.Array,
     def set0(arr, value):
         return arr.at[0].set(value)
 
-    i32 = jnp.int32
     state = GrowState(
         split_idx=jnp.array(0, i32),
         num_leaves=jnp.array(1, i32),
         has_split=jnp.isfinite(root_best["gain"]),
-        leaf_id=jnp.zeros(n_rows, dtype=i32),
-        leaf_hist=set0(jnp.zeros((L, F, B, 3), jnp.float32), root_hist),
-        leaf_sums=set0(jnp.zeros((L, 3), jnp.float32), root_sums),
-        leaf_depth=jnp.zeros(L, i32),
-        best_gain=set0(jnp.full(L, NEG_INF), root_best["gain"]),
-        best_feature=set0(jnp.zeros(L, i32), root_best["feature"]),
-        best_threshold=set0(jnp.zeros(L, i32), root_best["threshold_bin"]),
-        best_default_left=set0(jnp.zeros(L, jnp.bool_),
+        leaf_id=leaf_id0,
+        leaf_hist=set0(jnp.zeros((L + 1, F, B, 3), jnp.float32),
+                       root_hist),
+        leaf_sums=set0(jnp.zeros((L + 1, 3), jnp.float32), root_sums),
+        leaf_depth=jnp.zeros(L + 1, i32),
+        best_gain=set0(jnp.full(L + 1, NEG_INF), root_best["gain"]),
+        best_feature=set0(jnp.zeros(L + 1, i32), root_best["feature"]),
+        best_threshold=set0(jnp.zeros(L + 1, i32),
+                            root_best["threshold_bin"]),
+        best_default_left=set0(jnp.zeros(L + 1, jnp.bool_),
                                root_best["default_left"]),
-        best_left_sums=set0(jnp.zeros((L, 3), jnp.float32),
+        best_left_sums=set0(jnp.zeros((L + 1, 3), jnp.float32),
                             root_best["left_sums"]),
-        best_right_sums=set0(jnp.zeros((L, 3), jnp.float32),
+        best_right_sums=set0(jnp.zeros((L + 1, 3), jnp.float32),
                              root_best["right_sums"]),
-        split_feature=jnp.zeros(max(L - 1, 1), i32),
-        threshold_bin=jnp.zeros(max(L - 1, 1), i32),
-        default_left=jnp.zeros(max(L - 1, 1), jnp.bool_),
-        left_child=jnp.zeros(max(L - 1, 1), i32),
-        right_child=jnp.zeros(max(L - 1, 1), i32),
-        split_gain=jnp.zeros(max(L - 1, 1), jnp.float32),
-        internal_value=jnp.zeros(max(L - 1, 1), jnp.float32),
-        internal_count=jnp.zeros(max(L - 1, 1), jnp.float32),
-        leaf_value=set0(jnp.zeros(L, jnp.float32),
-                        calc_leaf_output(root_sums[0], root_sums[1],
-                                         cfg.lambda_l1, cfg.lambda_l2,
-                                         cfg.max_delta_step)),
-        leaf_count=set0(jnp.zeros(L, jnp.float32), root_sums[2]),
-        leaf_weight=set0(jnp.zeros(L, jnp.float32), root_sums[1]),
-        leaf_parent=jnp.full(L, -1, i32),
-        leaf_is_left=jnp.zeros(L, jnp.bool_),
+        split_feature=jnp.zeros(L, i32),
+        threshold_bin=jnp.zeros(L, i32),
+        default_left=jnp.zeros(L, jnp.bool_),
+        left_child=jnp.zeros(L, i32),
+        right_child=jnp.zeros(L, i32),
+        split_gain=jnp.zeros(L, jnp.float32),
+        internal_value=jnp.zeros(L, jnp.float32),
+        internal_count=jnp.zeros(L, jnp.float32),
+        leaf_value=set0(jnp.zeros(L + 1, jnp.float32),
+                        leaf_out(root_sums)),
+        leaf_count=set0(jnp.zeros(L + 1, jnp.float32), root_sums[2]),
+        leaf_weight=set0(jnp.zeros(L + 1, jnp.float32), root_sums[1]),
+        leaf_parent=jnp.full(L + 1, -1, i32),
+        leaf_is_left=jnp.zeros(L + 1, jnp.bool_),
     )
+
+    node_trash = L - 1  # real nodes occupy 0..L-2
+    leaf_trash = L
 
     def cond(s: GrowState):
         return (s.split_idx < L - 1) & s.has_split
@@ -194,117 +227,136 @@ def grow_tree(bins: jax.Array, vals: jax.Array, feat_num_bin: jax.Array,
     def body(s: GrowState) -> GrowState:
         gains = _masked_gains(s.best_gain, s.leaf_depth, s.num_leaves,
                               cfg.max_depth)
-        best_leaf = jnp.argmax(gains).astype(i32)
-        gain = gains[best_leaf]
-        node = s.split_idx
-        new_leaf = s.num_leaves
+        top_gain, top_leaf = jax.lax.top_k(gains, Kb)
+        remaining = (L - 1) - s.split_idx
+        valid = jnp.isfinite(top_gain) \
+            & (jnp.arange(Kb, dtype=i32) < remaining)
+        nv = jnp.sum(valid).astype(i32)
+        rank = jnp.cumsum(valid.astype(i32)) - 1
+        node_ids = jnp.where(valid, s.split_idx + rank, node_trash)
+        new_ids = jnp.where(valid, s.num_leaves + rank, leaf_trash)
+        tl_safe = jnp.where(valid, top_leaf, leaf_trash)
 
-        feature = s.best_feature[best_leaf]
-        tbin = s.best_threshold[best_leaf]
-        dleft = s.best_default_left[best_leaf]
-        lsums = s.best_left_sums[best_leaf]
-        rsums = s.best_right_sums[best_leaf]
+        # leaf -> batch-lane table
+        sel = jnp.full(L + 1, -1, i32).at[tl_safe].set(
+            jnp.where(valid, jnp.arange(Kb, dtype=i32), -1))
 
-        # ---- partition: update per-row leaf ids (DataPartition::Split) ----
-        col = jnp.take(bins, feature, axis=1).astype(i32)
-        is_missing = feat_has_nan[feature] & (col == feat_num_bin[feature] - 1)
-        goes_left = jnp.where(is_missing, dleft, col <= tbin)
-        in_leaf = s.leaf_id == best_leaf
-        leaf_id = jnp.where(in_leaf & ~goes_left, new_leaf, s.leaf_id)
+        # ---- partition: apply all selected splits in one row pass ------
+        lf = s.leaf_id
+        j = sel[lf]
+        selected = j >= 0
+        feat_r = s.best_feature[lf]
+        col = jnp.take_along_axis(
+            bins, feat_r[:, None].astype(i32), axis=1)[:, 0].astype(i32)
+        is_missing = feat_has_nan[feat_r] \
+            & (col == feat_num_bin[feat_r] - 1)
+        goes_left = jnp.where(is_missing, s.best_default_left[lf],
+                              col <= s.best_threshold[lf])
+        new_leaf_r = new_ids[jnp.maximum(j, 0)]
+        leaf_id = jnp.where(selected & ~goes_left,
+                            new_leaf_r.astype(i32), lf)
 
-        # ---- histograms: build smaller child, subtract for sibling -------
-        left_smaller = lsums[2] <= rsums[2]
-        smaller_leaf = jnp.where(left_smaller, best_leaf, new_leaf)
-        small_mask = (leaf_id == smaller_leaf).astype(jnp.float32)
-        small_hist = hist_fn(vals * small_mask[:, None])
-        parent_hist = s.leaf_hist[best_leaf]
-        large_hist = parent_hist - small_hist
-        left_hist = jnp.where(left_smaller, small_hist, large_hist)
-        right_hist = jnp.where(left_smaller, large_hist, small_hist)
-        leaf_hist = (s.leaf_hist.at[best_leaf].set(left_hist)
-                     .at[new_leaf].set(right_hist))
+        # ---- smaller-child histograms, one fused scan ------------------
+        lsums = s.best_left_sums[tl_safe]      # [Kb, 3]
+        rsums = s.best_right_sums[tl_safe]
+        psums = s.leaf_sums[tl_safe]
+        left_smaller = lsums[:, 2] <= rsums[:, 2]
+        small_ids = jnp.where(
+            valid, jnp.where(left_smaller, top_leaf, new_ids),
+            -1).astype(i32)
+        hist_small = hist_multi(leaf_id, small_ids)      # [Kb, F, B, 3]
+        parent_hist = s.leaf_hist[tl_safe]
+        hist_large = parent_hist - hist_small
+        ls4 = left_smaller[:, None, None, None]
+        left_hist = jnp.where(ls4, hist_small, hist_large)
+        right_hist = jnp.where(ls4, hist_large, hist_small)
+        leaf_hist = (s.leaf_hist.at[tl_safe].set(left_hist)
+                     .at[new_ids].set(right_hist))
 
-        # ---- new best splits for both children ---------------------------
-        bl = best_fn(left_hist, lsums)
-        br = best_fn(right_hist, rsums)
+        # ---- best splits for all 2*Kb children -------------------------
+        child_hists = jnp.concatenate([left_hist, right_hist])
+        child_sums = jnp.concatenate([lsums, rsums])
+        bests = best_vfn(child_hists, child_sums)
+        ids2 = jnp.concatenate([tl_safe, new_ids])
 
-        def upd2(arr, v_left, v_right):
-            return arr.at[best_leaf].set(v_left).at[new_leaf].set(v_right)
+        depth2 = s.leaf_depth[tl_safe] + 1
+        lvals = leaf_out(lsums)
+        rvals = leaf_out(rsums)
 
-        psums = s.leaf_sums[best_leaf]
-        depth = s.leaf_depth[best_leaf] + 1
-
-        # ---- tree wiring (Tree::Split) -----------------------------------
-        p = s.leaf_parent[best_leaf]
-        p_safe = jnp.maximum(p, 0)
-        was_left = s.leaf_is_left[best_leaf]
-        lc = jnp.where(
-            (p >= 0) & was_left, s.left_child.at[p_safe].set(node),
-            s.left_child)
-        rc = jnp.where(
-            (p >= 0) & ~was_left, s.right_child.at[p_safe].set(node),
-            s.right_child)
-        lc = lc.at[node].set(-best_leaf - 1)     # ~leaf encoding
-        rc = rc.at[node].set(-new_leaf - 1)
-
-        lval = calc_leaf_output(lsums[0], lsums[1], cfg.lambda_l1,
-                                cfg.lambda_l2, cfg.max_delta_step)
-        rval = calc_leaf_output(rsums[0], rsums[1], cfg.lambda_l1,
-                                cfg.lambda_l2, cfg.max_delta_step)
+        # ---- tree wiring -----------------------------------------------
+        lc = s.left_child.at[node_ids].set(-top_leaf - 1)
+        rc = s.right_child.at[node_ids].set(-new_ids - 1)
+        p = s.leaf_parent[tl_safe]
+        was_left = s.leaf_is_left[tl_safe]
+        fix_l = jnp.where(valid & (p >= 0) & was_left, p, node_trash)
+        fix_r = jnp.where(valid & (p >= 0) & ~was_left, p, node_trash)
+        # trash-lane writes land in the unused node slot L-1
+        lc = lc.at[fix_l].set(jnp.where(fix_l == node_trash, lc[fix_l],
+                                        node_ids))
+        rc = rc.at[fix_r].set(jnp.where(fix_r == node_trash, rc[fix_r],
+                                        node_ids))
 
         new = GrowState(
-            split_idx=node + 1,
-            num_leaves=new_leaf + 1,
-            has_split=jnp.array(True),  # recomputed below
+            split_idx=s.split_idx + nv,
+            num_leaves=s.num_leaves + nv,
+            has_split=jnp.array(True),
             leaf_id=leaf_id,
             leaf_hist=leaf_hist,
-            leaf_sums=upd2(s.leaf_sums, lsums, rsums),
-            leaf_depth=upd2(s.leaf_depth, depth, depth),
-            best_gain=upd2(s.best_gain, bl["gain"], br["gain"]),
-            best_feature=upd2(s.best_feature, bl["feature"], br["feature"]),
-            best_threshold=upd2(s.best_threshold, bl["threshold_bin"],
-                                br["threshold_bin"]),
-            best_default_left=upd2(s.best_default_left, bl["default_left"],
-                                   br["default_left"]),
-            best_left_sums=upd2(s.best_left_sums, bl["left_sums"],
-                                br["left_sums"]),
-            best_right_sums=upd2(s.best_right_sums, bl["right_sums"],
-                                 br["right_sums"]),
-            split_feature=s.split_feature.at[node].set(feature),
-            threshold_bin=s.threshold_bin.at[node].set(tbin),
-            default_left=s.default_left.at[node].set(dleft),
+            leaf_sums=s.leaf_sums.at[ids2].set(child_sums),
+            leaf_depth=s.leaf_depth.at[ids2].set(
+                jnp.concatenate([depth2, depth2])),
+            best_gain=s.best_gain.at[ids2].set(bests["gain"]),
+            best_feature=s.best_feature.at[ids2].set(bests["feature"]),
+            best_threshold=s.best_threshold.at[ids2].set(
+                bests["threshold_bin"]),
+            best_default_left=s.best_default_left.at[ids2].set(
+                bests["default_left"]),
+            best_left_sums=s.best_left_sums.at[ids2].set(
+                bests["left_sums"]),
+            best_right_sums=s.best_right_sums.at[ids2].set(
+                bests["right_sums"]),
+            split_feature=s.split_feature.at[node_ids].set(
+                s.best_feature[tl_safe]),
+            threshold_bin=s.threshold_bin.at[node_ids].set(
+                s.best_threshold[tl_safe]),
+            default_left=s.default_left.at[node_ids].set(
+                s.best_default_left[tl_safe]),
             left_child=lc,
             right_child=rc,
-            split_gain=s.split_gain.at[node].set(gain),
-            internal_value=s.internal_value.at[node].set(
-                calc_leaf_output(psums[0], psums[1], cfg.lambda_l1,
-                                 cfg.lambda_l2, cfg.max_delta_step)),
-            internal_count=s.internal_count.at[node].set(psums[2]),
-            leaf_value=upd2(s.leaf_value, lval, rval),
-            leaf_count=upd2(s.leaf_count, lsums[2], rsums[2]),
-            leaf_weight=upd2(s.leaf_weight, lsums[1], rsums[1]),
-            leaf_parent=upd2(s.leaf_parent, node, node),
-            leaf_is_left=upd2(s.leaf_is_left, jnp.array(True),
-                              jnp.array(False)),
+            split_gain=s.split_gain.at[node_ids].set(top_gain),
+            internal_value=s.internal_value.at[node_ids].set(
+                leaf_out(psums)),
+            internal_count=s.internal_count.at[node_ids].set(psums[:, 2]),
+            leaf_value=s.leaf_value.at[ids2].set(
+                jnp.concatenate([lvals, rvals])),
+            leaf_count=s.leaf_count.at[ids2].set(child_sums[:, 2]),
+            leaf_weight=s.leaf_weight.at[ids2].set(child_sums[:, 1]),
+            leaf_parent=s.leaf_parent.at[ids2].set(
+                jnp.concatenate([node_ids, node_ids])),
+            leaf_is_left=s.leaf_is_left.at[ids2].set(
+                jnp.concatenate([jnp.ones(Kb, jnp.bool_),
+                                 jnp.zeros(Kb, jnp.bool_)])),
         )
         next_gains = _masked_gains(new.best_gain, new.leaf_depth,
                                    new.num_leaves, cfg.max_depth)
-        return new._replace(has_split=jnp.isfinite(jnp.max(next_gains)))
+        return new._replace(
+            has_split=jnp.isfinite(jnp.max(next_gains)) & (nv > 0))
 
     final = jax.lax.while_loop(cond, body, state)
 
+    nn = max(L - 1, 1)
     tree = {
         "num_leaves": final.num_leaves,
-        "split_feature": final.split_feature,
-        "threshold_bin": final.threshold_bin,
-        "default_left": final.default_left,
-        "left_child": final.left_child,
-        "right_child": final.right_child,
-        "split_gain": final.split_gain,
-        "internal_value": final.internal_value,
-        "internal_count": final.internal_count,
-        "leaf_value": final.leaf_value,
-        "leaf_count": final.leaf_count,
-        "leaf_weight": final.leaf_weight,
+        "split_feature": final.split_feature[:nn],
+        "threshold_bin": final.threshold_bin[:nn],
+        "default_left": final.default_left[:nn],
+        "left_child": final.left_child[:nn],
+        "right_child": final.right_child[:nn],
+        "split_gain": final.split_gain[:nn],
+        "internal_value": final.internal_value[:nn],
+        "internal_count": final.internal_count[:nn],
+        "leaf_value": final.leaf_value[:L],
+        "leaf_count": final.leaf_count[:L],
+        "leaf_weight": final.leaf_weight[:L],
     }
     return tree, final.leaf_id
