@@ -133,7 +133,6 @@ class GBDT:
             config.tpu_rows_per_block,
             pad_rows(max(1, self.train_set.num_data // n_shards), 256))
         self.rows_per_block = rows_per_block
-        self.data = _DeviceData(self.train_set, rows_per_block, self.mesh)
 
         F = len(self.train_set.used_features)
         self.num_features = F
@@ -146,6 +145,14 @@ class GBDT:
              for f in self.train_set.used_features], dtype=bool)
         self.feat_num_bin = jnp.asarray(num_bin.astype(np.int32))
         self.feat_has_nan = jnp.asarray(has_nan)
+
+        # The fused Pallas kernel needs a TPU backend and int8-roundtrip
+        # bin ids (B <= 256); anything else takes the XLA einsum path.
+        self.use_pallas = bool(config.tpu_use_pallas and F > 0
+                               and self.B <= 256
+                               and jax.default_backend() == "tpu")
+        self.data = _DeviceData(self.train_set, rows_per_block, self.mesh,
+                                transposed=self.use_pallas)
 
         self.grow_cfg = self._make_grow_cfg()
 
@@ -209,6 +216,8 @@ class GBDT:
             num_bins=self.B,
             rows_per_block=self.rows_per_block,
             precise_histogram=config.tpu_double_precision_hist,
+            leaf_batch=max(1, config.tpu_leaf_batch),
+            use_pallas=self.use_pallas,
             axis_name=("data" if self.mesh is not None else ""),
         )
 
@@ -230,7 +239,8 @@ class GBDT:
                 return obj.get_gradients(s, label, weight, key=key)
             return obj.get_gradients(s, label, weight)
 
-        def grow_all(bins, score, g, h, mask_gh, mask_count, allowed):
+        def grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
+                     allowed):
             trees, leaf_ids = [], []
             new_score = score
             for k in range(K):
@@ -240,7 +250,7 @@ class GBDT:
                     [gk * mask_gh, hk * mask_gh, mask_count], axis=1)
                 tree, leaf_id = grow_tree(
                     bins, vals, self.feat_num_bin, self.feat_has_nan,
-                    allowed, gcfg)
+                    allowed, gcfg, bins_t=bins_t)
                 contrib = tree["leaf_value"][leaf_id] * lr
                 new_score = new_score.at[:, k].add(contrib)
                 trees.append(tree)
@@ -248,10 +258,10 @@ class GBDT:
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
             return stacked, jnp.stack(leaf_ids), new_score
 
-        def step_impl(bins, label, weight, score, mask_gh, mask_count,
-                      allowed, key):
+        def step_impl(bins, bins_t, label, weight, score, mask_gh,
+                      mask_count, allowed, key):
             g, h = gradients(score, label, weight, key)
-            return grow_all(bins, score, g, h, mask_gh, mask_count,
+            return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
                             allowed)
 
         top_rate = float(self.config.top_rate)
@@ -283,17 +293,17 @@ class GBDT:
             mask_count = (is_top | picked).astype(jnp.float32)
             return mask_gh, mask_count
 
-        def step_goss_impl(bins, label, weight, score, valid_mask,
+        def step_goss_impl(bins, bins_t, label, weight, score, valid_mask,
                            allowed, key):
             kg, km = jax.random.split(key)
             g, h = gradients(score, label, weight, kg)
             mask_gh, mask_count = goss_masks(g, h, valid_mask, km)
-            return grow_all(bins, score, g, h, mask_gh, mask_count,
+            return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
                             allowed)
 
-        def step_custom_impl(bins, score, g, h, mask_gh, mask_count,
-                             allowed):
-            return grow_all(bins, score, g, h, mask_gh, mask_count,
+        def step_custom_impl(bins, bins_t, score, g, h, mask_gh,
+                             mask_count, allowed):
+            return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
                             allowed)
 
         def valid_update_impl(valid_bins_scores, stacked_trees):
@@ -315,18 +325,18 @@ class GBDT:
 
             @jax.jit
             def step(score, mask_gh, mask_count, allowed, key):
-                return step_impl(d.bins, d.label, d.weight, score, mask_gh,
-                                 mask_count, allowed, key)
+                return step_impl(d.bins, d.bins_t, d.label, d.weight, score,
+                                 mask_gh, mask_count, allowed, key)
 
             @jax.jit
             def step_goss(score, allowed, key):
-                return step_goss_impl(d.bins, d.label, d.weight, score,
-                                      d.valid_mask, allowed, key)
+                return step_goss_impl(d.bins, d.bins_t, d.label, d.weight,
+                                      score, d.valid_mask, allowed, key)
 
             @jax.jit
             def step_custom(score, g, h, mask_gh, mask_count, allowed):
-                return step_custom_impl(d.bins, score, g, h, mask_gh,
-                                        mask_count, allowed)
+                return step_custom_impl(d.bins, d.bins_t, score, g, h,
+                                        mask_gh, mask_count, allowed)
 
             @jax.jit
             def valid_update(valid_scores, stacked_trees):
@@ -353,35 +363,39 @@ class GBDT:
             out_specs = (tree_specs, P(None, "data"), row2)
 
             w_spec = rep if d.weight is None else row1
+            bt_spec = P(None, "data")  # [F, n] sharded over rows
             sharded_step = shard_map(
                 step_impl, mesh=mesh,
-                in_specs=(row2, row1, w_spec, row2, row1, row1, rep, rep),
+                in_specs=(row2, bt_spec, row1, w_spec, row2, row1, row1,
+                          rep, rep),
                 out_specs=out_specs, check_vma=False)
             sharded_goss = shard_map(
                 step_goss_impl, mesh=mesh,
-                in_specs=(row2, row1, w_spec, row2, row1, rep, rep),
+                in_specs=(row2, bt_spec, row1, w_spec, row2, row1, rep,
+                          rep),
                 out_specs=out_specs, check_vma=False)
             grad_spec = row2 if K > 1 else row1
             sharded_custom = shard_map(
                 step_custom_impl, mesh=mesh,
-                in_specs=(row2, row2, grad_spec, grad_spec, row1, row1,
-                          rep),
+                in_specs=(row2, bt_spec, row2, grad_spec, grad_spec, row1,
+                          row1, rep),
                 out_specs=out_specs, check_vma=False)
 
             @jax.jit
             def step(score, mask_gh, mask_count, allowed, key):
-                return sharded_step(d.bins, d.label, d.weight, score,
-                                    mask_gh, mask_count, allowed, key)
+                return sharded_step(d.bins, d.bins_t, d.label, d.weight,
+                                    score, mask_gh, mask_count, allowed,
+                                    key)
 
             @jax.jit
             def step_goss(score, allowed, key):
-                return sharded_goss(d.bins, d.label, d.weight, score,
-                                    d.valid_mask, allowed, key)
+                return sharded_goss(d.bins, d.bins_t, d.label, d.weight,
+                                    score, d.valid_mask, allowed, key)
 
             @jax.jit
             def step_custom(score, g, h, mask_gh, mask_count, allowed):
-                return sharded_custom(d.bins, score, g, h, mask_gh,
-                                      mask_count, allowed)
+                return sharded_custom(d.bins, d.bins_t, score, g, h,
+                                      mask_gh, mask_count, allowed)
 
             @jax.jit
             def valid_update(valid_scores, stacked_trees):

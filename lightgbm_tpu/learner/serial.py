@@ -116,20 +116,21 @@ def _masked_gains(gain, leaf_depth, num_leaves, max_depth):
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def grow_tree(bins: jax.Array, bins_t: jax.Array, vals: jax.Array,
+def grow_tree(bins: jax.Array, vals: jax.Array,
               feat_num_bin: jax.Array, feat_has_nan: jax.Array,
-              allowed_feature: jax.Array,
-              cfg: GrowConfig) -> Tuple[Dict[str, jax.Array], jax.Array]:
+              allowed_feature: jax.Array, cfg: GrowConfig,
+              bins_t: jax.Array = None,
+              ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
     Args:
       bins: ``[n, F]`` row-major binned matrix (partition gathers).
-      bins_t: ``[F, n]`` int8 feature-major copy (Pallas kernel input;
-        ignored on the XLA fallback path).
       vals: ``[n, 3]`` float32 (grad*mask, hess*mask, count-mask).
       feat_num_bin / feat_has_nan: ``[F]`` per-feature bin metadata.
       allowed_feature: ``[F]`` bool feature-sampling mask for this tree.
       cfg: static growth config.
+      bins_t: ``[F, n]`` int8 feature-major copy; required (and only read)
+        when ``cfg.use_pallas`` — the Pallas kernel input.
 
     Returns:
       (tree dict of fixed-size arrays + ``num_leaves``, per-row leaf_id).
@@ -142,8 +143,20 @@ def grow_tree(bins: jax.Array, bins_t: jax.Array, vals: jax.Array,
     scfg = cfg.split_config
 
     if cfg.use_pallas:
+        if bins_t is None:
+            raise ValueError("cfg.use_pallas=True requires bins_t ([F, n] "
+                             "feature-major int8 binned matrix)")
+        if B > 256:
+            raise ValueError(
+                f"Pallas histogram path supports at most 256 bins (int8 "
+                f"storage round-trips 0..255); got num_bins={B}. Use the "
+                f"XLA path for wider histograms.")
         vals_t = vals.T
-        pr = min(cfg.rows_per_block, 2048)
+        # block size must divide the padded row count; rows_per_block does
+        # (padding guarantees it), so cap at <=2048 via gcd to keep the
+        # one-hot VMEM-resident without breaking divisibility
+        import math
+        pr = math.gcd(cfg.rows_per_block, 2048)
 
         def hist_multi(leaf_id, small_ids):
             h = multi_leaf_histogram(bins_t, vals_t, leaf_id, small_ids,
