@@ -251,7 +251,22 @@ class GBDT:
                 tree, leaf_id = grow_tree(
                     bins, vals, self.feat_num_bin, self.feat_has_nan,
                     allowed, gcfg, bins_t=bins_t)
-                contrib = tree["leaf_value"][leaf_id] * lr
+                # leaf_value[leaf_id] as a one-hot matmul: a per-row
+                # gather into a [L] table runs on the TPU scalar unit
+                # (~9ms/Mrow); the masked contraction is ~free on the MXU.
+                # The one-hot operand is O(n*L), so fall back to the
+                # gather for very wide trees where it would dominate HBM.
+                L = tree["leaf_value"].shape[0]
+                if L <= 512:
+                    onehot = (leaf_id[:, None]
+                              == jnp.arange(L, dtype=jnp.int32)[None, :])
+                    contrib = jax.lax.dot_general(
+                        onehot.astype(jnp.float32),
+                        tree["leaf_value"][:, None],
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        precision=jax.lax.Precision.HIGHEST)[:, 0] * lr
+                else:
+                    contrib = tree["leaf_value"][leaf_id] * lr
                 new_score = new_score.at[:, k].add(contrib)
                 trees.append(tree)
                 leaf_ids.append(leaf_id)
@@ -420,6 +435,52 @@ class GBDT:
                 score = score.at[:, k].add(contrib)
             return score
 
+        # ---- fused multi-iteration chunk (one dispatch per n iters) ----
+        # Over a tunneled TPU each jit dispatch costs a latency round-trip
+        # (~80ms); scanning the whole boosting step amortizes it. Only the
+        # pure-jit path qualifies (checked in train_chunk).
+        self._chunk_cache: Dict[Tuple[int, bool], Callable] = {}
+        F = self.num_features
+
+        def make_chunk(goss: bool):
+            allowed_all = jnp.ones(F, dtype=bool)
+            d_ = self.data
+
+            def chunk_impl(bins, bins_t, label, weight, score, valid_mask,
+                           keys):
+                def body(sc, bkey):
+                    if goss:
+                        stacked, _lid, ns = step_goss_impl(
+                            bins, bins_t, label, weight, sc, valid_mask,
+                            allowed_all, bkey)
+                    else:
+                        stacked, _lid, ns = step_impl(
+                            bins, bins_t, label, weight, sc, valid_mask,
+                            valid_mask, allowed_all, bkey)
+                    return ns, stacked
+                return jax.lax.scan(body, score, keys)
+
+            if mesh is None:
+                @jax.jit
+                def chunk(score, keys):
+                    return chunk_impl(d_.bins, d_.bins_t, d_.label,
+                                      d_.weight, score, d_.valid_mask,
+                                      keys)
+                return chunk
+
+            sharded_chunk = shard_map(
+                chunk_impl, mesh=mesh,
+                in_specs=(row2, bt_spec, row1, w_spec, row2, row1, rep),
+                out_specs=(row2, tree_specs), check_vma=False)
+
+            @jax.jit
+            def chunk(score, keys):
+                return sharded_chunk(d_.bins, d_.bins_t, d_.label,
+                                     d_.weight, score, d_.valid_mask, keys)
+            return chunk
+
+        self._make_chunk = make_chunk
+
         self._step = step
         self._step_goss = step_goss
         self._step_custom = step_custom
@@ -493,6 +554,11 @@ class GBDT:
             mask_gh, mask_count = self._bagging_masks()
             stacked, leaf_ids, new_score = self._step(
                 self.score, mask_gh, mask_count, allowed, key)
+        # start device->host copies of the (tiny) tree arrays immediately:
+        # over a tunneled TPU each sync transfer is a latency round-trip,
+        # so issue them all async and overlap with the step itself
+        for leaf in jax.tree.leaves(stacked):
+            leaf.copy_to_host_async()
         # leaf-output renewal (L1/quantile/MAPE percentile re-fit,
         # ObjectiveFunction::RenewTreeOutput): recompute leaf values from
         # per-leaf residual percentiles of the PRE-update score, then
@@ -519,13 +585,95 @@ class GBDT:
         if self.valid_scores:
             self.valid_scores = self._valid_update(self.valid_scores,
                                                    stacked)
-        host = jax.tree.map(np.asarray, stacked)
+        self._append_host_trees(self._fetch_tree_arrays(stacked))
+        self.iter_ += 1
+
+    def _fetch_tree_arrays(self, stacked) -> Dict[str, np.ndarray]:
+        """Device->host transfer of the stacked tree arrays: issue every
+        copy async first (over a tunneled TPU each sync transfer is a
+        latency round-trip), then materialize."""
+        for leaf in jax.tree.leaves(stacked):
+            leaf.copy_to_host_async()
+        return jax.tree.map(np.asarray, stacked)
+
+    def _append_host_trees(self, host: Dict[str, np.ndarray]) -> None:
+        """Append one iteration's K per-class trees (host arrays with a
+        leading class dim) to the model list."""
         for k in range(self.num_class):
             arrays = {key: v[k] for key, v in host.items()}
             self.models.append(Tree.from_device(
                 arrays, self.config.learning_rate,
                 self.train_set.bin_mappers, self.train_set.used_features))
-        self.iter_ += 1
+
+    def can_fuse_iters(self) -> bool:
+        """True when boosting iterations are expressible as one scanned
+        device program: no custom fobj, no host-side leaf renewal, no
+        host-RNG bagging, no per-tree feature sampling, no valid-set score
+        carries."""
+        c = self.config
+        renews = (type(self.objective).renew_tree_output
+                  is not Objective.renew_tree_output)
+        use_bagging = (c.bagging_freq > 0
+                       and (c.bagging_fraction < 1.0
+                            or c.pos_bagging_fraction < 1.0
+                            or c.neg_bagging_fraction < 1.0))
+        return (self.fobj is None and not renews and not use_bagging
+                and c.feature_fraction >= 1.0 and not self.valid_data)
+
+    def train_chunk(self, n_iters: int) -> None:
+        """Run ``n_iters`` boosting iterations in one device dispatch
+        (``lax.scan`` over the fused step). Produces the same models as
+        ``n_iters`` calls of train_one_iter (same per-iter RNG keys);
+        falls back to the per-iter loop when ineligible."""
+        if n_iters <= 0:
+            return
+        c = self.config
+        if n_iters == 1 or not self.can_fuse_iters():
+            for _ in range(n_iters):
+                self.train_one_iter()
+            return
+        is_goss = c.data_sample_strategy == "goss"
+        goss_start = (int(1.0 / max(c.learning_rate, 1e-6))
+                      if is_goss else None)
+        # fixed scan length: every distinct length is a separate XLA
+        # compile (trip count is static), so run whole chunks of D and
+        # finish the remainder per-iter
+        D = max(2, c.tpu_fuse_iters)
+        done = 0
+        while done < n_iters:
+            it0 = self.iter_
+            goss_now = is_goss and it0 >= goss_start
+            avail = n_iters - done
+            if is_goss and not goss_now:
+                avail = min(avail, goss_start - it0)
+            if avail < D:
+                for _ in range(avail):
+                    self.train_one_iter()
+                done += avail
+                continue
+            n = D
+            if goss_now not in self._chunk_cache:
+                self._chunk_cache[goss_now] = self._make_chunk(goss_now)
+            # identical keys to train_one_iter's PRNGKey(seed + iter):
+            # pack the threefry hi/lo uint32 halves explicitly, matching
+            # PRNGKey's truncation behavior (hi word only under x64)
+            seeds64 = (np.arange(it0, it0 + n, dtype=np.int64)
+                       + np.int64(c.objective_seed)).astype(np.uint64)
+            hi = ((seeds64 >> np.uint64(32)).astype(np.uint32)
+                  if jax.config.jax_enable_x64
+                  else np.zeros(n, np.uint32))
+            keys = jnp.asarray(np.stack(
+                [hi, (seeds64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
+                axis=1))
+            new_score, stacked = self._chunk_cache[goss_now](
+                self.score, keys)
+            self.score = new_score
+            host = self._fetch_tree_arrays(stacked)
+            for i in range(n):
+                self._append_host_trees(
+                    {kk: v[i] for kk, v in host.items()})
+            self.iter_ += n
+            done += n
 
     def _pad_custom(self, arr: np.ndarray) -> jnp.ndarray:
         arr = np.asarray(arr, dtype=np.float32)

@@ -236,6 +236,9 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # order, larger batches fuse K leaf histograms into one data scan
     "tpu_leaf_batch": _P("int", 16, [], (1, 256)),
     "tpu_use_pallas": _P("bool", True),
+    # boosting iterations fused into one device dispatch (lax.scan) when
+    # the pure-jit path applies (no callbacks/valid sets/host bagging)
+    "tpu_fuse_iters": _P("int", 10, [], (1, 1000)),
 }
 
 # alias -> canonical name

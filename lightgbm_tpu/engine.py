@@ -76,6 +76,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
     train_as_valid = valid_sets and any(vs is train_set
                                         for vs in valid_sets)
 
+    # fused fast path: with no per-iteration host work (callbacks, eval,
+    # custom fobj), run the whole training as chunked device dispatches —
+    # identical models, one dispatch per tpu_fuse_iters iterations
+    if (not callbacks_before and not callbacks_after and not valid_sets
+            and not cfg.is_provide_training_metric and fobj is None
+            and cfg.tpu_fuse_iters > 1
+            and booster.engine.can_fuse_iters()):
+        booster.engine.train_chunk(num_boost_round)
+        booster.best_iteration = booster.current_iteration()
+        return booster
+
     for it in range(num_boost_round):
         env_pre = callback_mod.CallbackEnv(
             model=booster, params=params, iteration=it,
