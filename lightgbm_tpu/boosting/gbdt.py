@@ -140,11 +140,18 @@ class GBDT:
         self.max_num_bin = int(num_bin.max()) if F else 2
         # static histogram width: pad to a lane-friendly multiple
         self.B = max(8, _ceil_to(self.max_num_bin, 8))
+        is_cat = np.array(
+            [self.train_set.bin_mappers[f].bin_type == "categorical"
+             for f in self.train_set.used_features], dtype=bool)
+        # categorical NaN/unseen is bin 0 and routes via bitset-miss, not
+        # the numerical last-bin NaN convention
         has_nan = np.array(
             [self.train_set.bin_mappers[f].missing_type == "nan"
-             for f in self.train_set.used_features], dtype=bool)
+             for f in self.train_set.used_features], dtype=bool) & ~is_cat
         self.feat_num_bin = jnp.asarray(num_bin.astype(np.int32))
         self.feat_has_nan = jnp.asarray(has_nan)
+        self.has_categorical = bool(is_cat.any())
+        self.feat_is_cat = jnp.asarray(is_cat)
 
         # The fused Pallas kernel needs a TPU backend and int8-roundtrip
         # bin ids (B <= 256); anything else takes the XLA einsum path.
@@ -219,6 +226,12 @@ class GBDT:
             leaf_batch=max(1, config.tpu_leaf_batch),
             use_pallas=self.use_pallas,
             axis_name=("data" if self.mesh is not None else ""),
+            has_categorical=self.has_categorical,
+            max_cat_threshold=config.max_cat_threshold,
+            cat_smooth=config.cat_smooth,
+            cat_l2=config.cat_l2,
+            max_cat_to_onehot=config.max_cat_to_onehot,
+            min_data_per_group=config.min_data_per_group,
         )
 
     # ------------------------------------------------------------------
@@ -250,7 +263,8 @@ class GBDT:
                     [gk * mask_gh, hk * mask_gh, mask_count], axis=1)
                 tree, leaf_id = grow_tree(
                     bins, vals, self.feat_num_bin, self.feat_has_nan,
-                    allowed, gcfg, bins_t=bins_t)
+                    allowed, gcfg, bins_t=bins_t,
+                    is_cat=self.feat_is_cat)
                 # leaf_value[leaf_id] as a one-hot matmul: a per-row
                 # gather into a [L] table runs on the TPU scalar unit
                 # (~9ms/Mrow); the masked contraction is ~free on the MXU.
@@ -370,11 +384,13 @@ class GBDT:
             row2 = P("data", None)
             row1 = P("data")
             rep = P()
-            tree_specs = {k: rep for k in (
-                "num_leaves", "split_feature", "threshold_bin",
-                "default_left", "left_child", "right_child", "split_gain",
-                "internal_value", "internal_count", "leaf_value",
-                "leaf_count", "leaf_weight")}
+            tree_keys = ["num_leaves", "split_feature", "threshold_bin",
+                         "default_left", "left_child", "right_child",
+                         "split_gain", "internal_value", "internal_count",
+                         "leaf_value", "leaf_count", "leaf_weight"]
+            if self.has_categorical:
+                tree_keys += ["is_cat", "cat_bitset"]
+            tree_specs = {k: rep for k in tree_keys}
             out_specs = (tree_specs, P(None, "data"), row2)
 
             w_spec = rep if d.weight is None else row1
@@ -628,7 +644,8 @@ class GBDT:
         if n_iters <= 0:
             return
         c = self.config
-        if n_iters == 1 or not self.can_fuse_iters():
+        if n_iters == 1 or c.tpu_fuse_iters <= 1 \
+                or not self.can_fuse_iters():
             for _ in range(n_iters):
                 self.train_one_iter()
             return
@@ -749,6 +766,18 @@ class GBDT:
             "leaf_value": padded(
                 lambda t: t.leaf_value.astype(np.float32), L, np.float32),
         }
+        if any(t.cat_bitset_bins is not None for t in trees):
+            W = max(t.cat_bitset_bins.shape[1] for t in trees
+                    if t.cat_bitset_bins is not None)
+            bs = np.zeros((len(trees), Ln, W), dtype=np.uint32)
+            for i, t in enumerate(trees):
+                if t.cat_bitset_bins is not None:
+                    a = t.cat_bitset_bins
+                    bs[i, :a.shape[0], :a.shape[1]] = a
+            stacked["is_cat"] = padded(
+                lambda t: (t.is_categorical if t.is_categorical is not None
+                           else np.zeros(t.num_nodes, bool)), Ln, bool)
+            stacked["cat_bitset"] = jnp.asarray(bs)
         class_idx = jnp.asarray(
             np.arange(start, start + num, dtype=np.int32) % self.num_class)
         return stacked, class_idx

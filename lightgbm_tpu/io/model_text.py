@@ -62,6 +62,10 @@ class HostModel:
             mt = np.array(
                 [_MISSING_CODE[ds.bin_mappers[int(f)].missing_type]
                  for f in t2.split_feature], dtype=np.int32)
+            if t2.is_categorical is not None:
+                # categorical missing routes via bitset-miss, not the
+                # numerical default-direction machinery
+                mt[t2.is_categorical[:len(mt)]] = 0
             if ti < engine.num_class:
                 # fold init score into the first iteration's trees (AddBias)
                 bias = float(engine.init_scores[ti % engine.num_class])
@@ -184,11 +188,18 @@ def _tree_to_string(t: Tree, missing_type: Optional[np.ndarray]) -> str:
     nn = t.num_nodes
     if missing_type is None:
         missing_type = np.zeros(nn, dtype=np.int32)
-    decision_type = ((np.asarray(t.default_left[:nn]).astype(np.int32) * 2)
+    is_cat = (t.is_categorical[:nn].astype(np.int32)
+              if t.is_categorical is not None
+              else np.zeros(nn, dtype=np.int32))
+    num_cat = int(len(t.cat_boundaries) - 1) \
+        if t.cat_boundaries is not None else 0
+    decision_type = (is_cat
+                     | (np.asarray(t.default_left[:nn]).astype(np.int32)
+                        * 2)
                      | (missing_type[:nn].astype(np.int32) << 2))
     lines = [
         f"num_leaves={t.num_leaves}",
-        "num_cat=0",
+        f"num_cat={num_cat}",
         _arr("split_feature", t.split_feature[:nn]),
         _arr("split_gain", t.split_gain[:nn], "{:g}"),
         _arr("threshold", t.threshold_real[:nn], "{:.17g}"),
@@ -204,6 +215,11 @@ def _tree_to_string(t: Tree, missing_type: Optional[np.ndarray]) -> str:
         "is_linear=0",
         f"shrinkage={t.shrinkage:g}",
     ]
+    if num_cat > 0:
+        # LightGBM layout: threshold[i] indexes cat_boundaries, whose
+        # [idx, idx+1) range delimits uint32 words in cat_threshold
+        lines.insert(6, _arr("cat_threshold", t.cat_threshold))
+        lines.insert(6, _arr("cat_boundaries", t.cat_boundaries))
     return "\n".join(lines) + "\n"
 
 
@@ -276,6 +292,16 @@ def _parse_tree_block(block: str) -> (Tree, np.ndarray):
     default_left = (decision_type & 2) > 0
     missing_type = (decision_type >> 2) & 3
     threshold = getf("threshold", nn)
+    num_cat = int(kv.get("num_cat", 0))
+    is_categorical = None
+    cat_boundaries = None
+    cat_threshold = None
+    if num_cat > 0:
+        is_categorical = (decision_type & 1) > 0
+        cat_boundaries = np.array(kv["cat_boundaries"].split(),
+                                  dtype=np.int64)
+        cat_threshold = np.array(kv["cat_threshold"].split(),
+                                 dtype=np.float64).astype(np.uint32)
     t = Tree(
         num_leaves=num_leaves,
         split_feature=geti("split_feature", nn),
@@ -291,6 +317,9 @@ def _parse_tree_block(block: str) -> (Tree, np.ndarray):
         leaf_count=geti("leaf_count", num_leaves).astype(np.int64),
         leaf_weight=getf("leaf_weight", num_leaves),
         shrinkage=float(kv.get("shrinkage", 1.0)),
+        cat_boundaries=cat_boundaries,
+        cat_threshold=cat_threshold,
+        is_categorical=is_categorical,
     )
     return t, missing_type
 

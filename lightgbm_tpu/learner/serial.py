@@ -63,6 +63,18 @@ class GrowConfig:
     use_pallas: bool = False
     # mesh axis for data-parallel histogram reduction ("" = single device)
     axis_name: str = ""
+    # categorical split search (zero-cost when has_categorical=False)
+    has_categorical: bool = False
+    max_cat_threshold: int = 32
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
+
+    @property
+    def cat_words(self) -> int:
+        """uint32 words per categorical bitset (over bins)."""
+        return (self.num_bins + 31) // 32
 
     @property
     def split_config(self) -> SplitConfig:
@@ -71,7 +83,12 @@ class GrowConfig:
             min_data_in_leaf=self.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
             min_gain_to_split=self.min_gain_to_split,
-            max_delta_step=self.max_delta_step)
+            max_delta_step=self.max_delta_step,
+            has_categorical=self.has_categorical,
+            max_cat_threshold=self.max_cat_threshold,
+            cat_smooth=self.cat_smooth, cat_l2=self.cat_l2,
+            max_cat_to_onehot=self.max_cat_to_onehot,
+            min_data_per_group=self.min_data_per_group)
 
 
 class GrowState(NamedTuple):
@@ -91,9 +108,13 @@ class GrowState(NamedTuple):
     best_default_left: jnp.ndarray
     best_left_sums: jnp.ndarray     # [L+1, 3]
     best_right_sums: jnp.ndarray
+    best_is_cat: jnp.ndarray        # [L+1]
+    best_cat_bitset: jnp.ndarray    # [L+1, W]
     split_feature: jnp.ndarray      # [L]
     threshold_bin: jnp.ndarray
     default_left: jnp.ndarray
+    node_is_cat: jnp.ndarray        # [L]
+    node_cat_bitset: jnp.ndarray    # [L, W]
     left_child: jnp.ndarray
     right_child: jnp.ndarray
     split_gain: jnp.ndarray
@@ -120,6 +141,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               feat_num_bin: jax.Array, feat_has_nan: jax.Array,
               allowed_feature: jax.Array, cfg: GrowConfig,
               bins_t: jax.Array = None,
+              is_cat: jax.Array = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -131,6 +153,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
       cfg: static growth config.
       bins_t: ``[F, n]`` int8 feature-major copy; required (and only read)
         when ``cfg.use_pallas`` — the Pallas kernel input.
+      is_cat: ``[F]`` bool categorical-feature mask; only read when
+        ``cfg.has_categorical``.
 
     Returns:
       (tree dict of fixed-size arrays + ``num_leaves``, per-row leaf_id).
@@ -173,9 +197,12 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 h = jax.lax.psum(h, cfg.axis_name)
             return h
 
+    W = cfg.cat_words
+    if not cfg.has_categorical:
+        is_cat = None
     best_fn = functools.partial(
         find_best_split, num_bin=feat_num_bin, has_nan=feat_has_nan,
-        allowed_feature=allowed_feature, cfg=scfg)
+        allowed_feature=allowed_feature, cfg=scfg, is_cat=is_cat)
     best_vfn = jax.vmap(lambda h, s: best_fn(h, s))
 
     def leaf_out(sums):
@@ -215,9 +242,15 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                             root_best["left_sums"]),
         best_right_sums=set0(jnp.zeros((L + 1, 3), jnp.float32),
                              root_best["right_sums"]),
+        best_is_cat=set0(jnp.zeros(L + 1, jnp.bool_),
+                         root_best["is_cat"]),
+        best_cat_bitset=set0(jnp.zeros((L + 1, W), jnp.uint32),
+                             root_best["cat_bitset"]),
         split_feature=jnp.zeros(L, i32),
         threshold_bin=jnp.zeros(L, i32),
         default_left=jnp.zeros(L, jnp.bool_),
+        node_is_cat=jnp.zeros(L, jnp.bool_),
+        node_cat_bitset=jnp.zeros((L, W), jnp.uint32),
         left_child=jnp.zeros(L, i32),
         right_child=jnp.zeros(L, i32),
         split_gain=jnp.zeros(L, jnp.float32),
@@ -261,17 +294,26 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         mask_k = (lf[:, None] == tl_safe[None, :]) & valid[None, :]
         selected = jnp.any(mask_k, axis=1)
         bfeat_k = s.best_feature[tl_safe]
-        packed = jnp.stack(
-            [bfeat_k.astype(jnp.float32),
-             s.best_threshold[tl_safe].astype(jnp.float32),
-             s.best_default_left[tl_safe].astype(jnp.float32),
-             new_ids.astype(jnp.float32),
-             feat_num_bin[bfeat_k].astype(jnp.float32),
-             feat_has_nan[bfeat_k].astype(jnp.float32)], axis=1)
+        attr_cols = [bfeat_k.astype(jnp.float32),
+                     s.best_threshold[tl_safe].astype(jnp.float32),
+                     s.best_default_left[tl_safe].astype(jnp.float32),
+                     new_ids.astype(jnp.float32),
+                     feat_num_bin[bfeat_k].astype(jnp.float32),
+                     feat_has_nan[bfeat_k].astype(jnp.float32)]
+        if cfg.has_categorical:
+            # bitset words split into 16-bit halves: exact in float32,
+            # so the same masked matmul carries them per row
+            bs_k = s.best_cat_bitset[tl_safe]                 # [Kb, W]
+            attr_cols.append(s.best_is_cat[tl_safe].astype(jnp.float32))
+            attr_cols.extend(jnp.moveaxis(
+                (bs_k & jnp.uint32(0xFFFF)).astype(jnp.float32), 1, 0))
+            attr_cols.extend(jnp.moveaxis(
+                (bs_k >> jnp.uint32(16)).astype(jnp.float32), 1, 0))
+        packed = jnp.stack(attr_cols, axis=1)
         row_attr = jax.lax.dot_general(
             mask_k.astype(jnp.float32), packed,
             dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST)           # [n, 6]
+            precision=jax.lax.Precision.HIGHEST)       # [n, 6(+1+2W)]
         feat_r = row_attr[:, 0].astype(i32)
         thr_r = row_attr[:, 1].astype(i32)
         dl_r = row_attr[:, 2] > 0.5
@@ -284,6 +326,18 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         col = jnp.sum(jnp.where(oh_f, bins.astype(i32), 0), axis=1)
         is_missing = hn_r & (col == nb_r - 1)
         goes_left = jnp.where(is_missing, dl_r, col <= thr_r)
+        if cfg.has_categorical:
+            is_cat_r = row_attr[:, 6] > 0.5
+            oh_w = ((col >> 5)[:, None]
+                    == jnp.arange(W, dtype=i32)[None, :])     # [n, W]
+            lo16 = jnp.sum(jnp.where(oh_w, row_attr[:, 7:7 + W], 0.0),
+                           axis=1).astype(jnp.uint32)
+            hi16 = jnp.sum(jnp.where(oh_w, row_attr[:, 7 + W:7 + 2 * W],
+                                     0.0), axis=1).astype(jnp.uint32)
+            word = lo16 | (hi16 << jnp.uint32(16))
+            cat_left = ((word >> (col & 31).astype(jnp.uint32))
+                        & jnp.uint32(1)) > 0
+            goes_left = jnp.where(is_cat_r, cat_left, goes_left)
         leaf_id = jnp.where(selected & ~goes_left, new_leaf_r, lf)
 
         # ---- smaller-child histograms, one fused scan ------------------
@@ -345,12 +399,19 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 bests["left_sums"]),
             best_right_sums=s.best_right_sums.at[ids2].set(
                 bests["right_sums"]),
+            best_is_cat=s.best_is_cat.at[ids2].set(bests["is_cat"]),
+            best_cat_bitset=s.best_cat_bitset.at[ids2].set(
+                bests["cat_bitset"]),
             split_feature=s.split_feature.at[node_ids].set(
                 s.best_feature[tl_safe]),
             threshold_bin=s.threshold_bin.at[node_ids].set(
                 s.best_threshold[tl_safe]),
             default_left=s.default_left.at[node_ids].set(
                 s.best_default_left[tl_safe]),
+            node_is_cat=s.node_is_cat.at[node_ids].set(
+                s.best_is_cat[tl_safe]),
+            node_cat_bitset=s.node_cat_bitset.at[node_ids].set(
+                s.best_cat_bitset[tl_safe]),
             left_child=lc,
             right_child=rc,
             split_gain=s.split_gain.at[node_ids].set(top_gain),
@@ -389,4 +450,10 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         "leaf_count": final.leaf_count[:L],
         "leaf_weight": final.leaf_weight[:L],
     }
+    if cfg.has_categorical:
+        # only emitted when categorical features exist, so downstream
+        # traversal (tree_predict_binned) skips the bitset branch — and
+        # its per-row gathers — on pure-numerical datasets
+        tree["is_cat"] = final.node_is_cat[:nn]
+        tree["cat_bitset"] = final.node_cat_bitset[:nn]
     return tree, final.leaf_id

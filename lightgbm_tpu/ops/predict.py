@@ -49,6 +49,15 @@ def tree_predict_binned(tree: Dict[str, jax.Array], bins: jax.Array,
                                   axis=1)[:, 0].astype(jnp.int32)
         missing = feat_has_nan[feat] & (col == feat_num_bin[feat] - 1)
         go_left = jnp.where(missing, dleft, col <= thr)
+        if "is_cat" in tree:
+            # categorical: bin-membership test in the node's bitset
+            # (bin 0 / unseen categories miss every bitset -> right)
+            bitset = tree["cat_bitset"][nd]            # [n, W]
+            word = jnp.take_along_axis(
+                bitset, (col >> 5)[:, None], axis=1)[:, 0]
+            cat_left = ((word >> (col & 31).astype(jnp.uint32))
+                        & jnp.uint32(1)) > 0
+            go_left = jnp.where(tree["is_cat"][nd], cat_left, go_left)
         nxt = jnp.where(go_left, tree["left_child"][nd],
                         tree["right_child"][nd])
         return jnp.where(node >= 0, nxt, node)

@@ -105,7 +105,10 @@ def _tree_shap_row(tree, x: np.ndarray, phi: np.ndarray) -> None:
         f = int(tree.split_feature[node])
         v = x[f]
         thr = tree.threshold_real[node]
-        if np.isnan(v):
+        if tree.is_categorical is not None and tree.is_categorical[node]:
+            go_left = bool(tree._cat_go_left(np.array([thr]),
+                                             np.array([v]))[0])
+        elif np.isnan(v):
             go_left = bool(tree.default_left[node])
         else:
             go_left = v <= thr

@@ -37,6 +37,15 @@ class SplitConfig:
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
+    # categorical split search (FindBestThresholdCategorical):
+    # one-hot below max_cat_to_onehot distinct values, else sorted
+    # many-vs-many by grad/(hess+cat_smooth) with cat_l2 regularization
+    has_categorical: bool = False
+    max_cat_threshold: int = 32
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
 
 
 def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
@@ -65,10 +74,109 @@ def calc_leaf_output(sum_g: jax.Array, sum_h: jax.Array, l1: float,
     return out
 
 
+def _pack_bitset(inset: jax.Array, n_words: int) -> jax.Array:
+    """Pack a ``[B]`` bool left-set into ``[n_words]`` uint32 words."""
+    b = inset.shape[0]
+    pad = n_words * 32 - b
+    if pad > 0:
+        inset = jnp.concatenate([inset, jnp.zeros(pad, inset.dtype)])
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :]
+    return jnp.sum(inset.reshape(n_words, 32).astype(jnp.uint32) * weights,
+                   axis=1, dtype=jnp.uint32)
+
+
+def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
+                      cfg: SplitConfig):
+    """Best categorical split (one-hot + sorted many-vs-many).
+
+    Reference: ``FindBestThresholdCategoricalInner``
+    (src/treelearner/feature_histogram.hpp, UNVERIFIED): features with
+    few categories scan one-vs-rest; otherwise categories are sorted by
+    ``sum_grad / (sum_hess + cat_smooth)`` and prefixes of the sorted
+    order (both directions, capped at ``max_cat_threshold``) form the
+    left set, with ``cat_l2`` added to the L2 term.
+    ``min_data_per_group`` is applied to both children of a categorical
+    split. Bin 0 (the NaN/unseen-category bin) is never elected into a
+    left set — unseen categories route right at predict, matching the
+    bitset-miss semantics of the reference.
+
+    Returns (gain [scalar], feature, left_sums, inset [B] bool over bins).
+    """
+    f, b, _ = hist.shape
+    bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]
+    cnt = hist[..., 2]
+    l1, l2c = cfg.lambda_l1, cfg.lambda_l2 + cfg.cat_l2
+    pg, ph, pc = parent_sums[0], parent_sums[1], parent_sums[2]
+    parent_gain = leaf_gain(pg, ph, l1, l2c)
+    min_cnt = float(max(cfg.min_data_in_leaf, cfg.min_data_per_group))
+
+    cat_ok = is_cat & allowed_feature
+    valid_bin = ((bin_idx >= 1) & (bin_idx < num_bin[:, None])
+                 & (cnt > 0) & cat_ok[:, None])               # [F, B]
+
+    def child_gain(lg, lh, lc):
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        g = (leaf_gain(lg, lh, l1, l2c) + leaf_gain(rg, rh, l1, l2c)
+             - parent_gain)
+        ok = ((lc >= min_cnt) & (rc >= min_cnt)
+              & (lh >= cfg.min_sum_hessian_in_leaf)
+              & (rh >= cfg.min_sum_hessian_in_leaf)
+              & (g > cfg.min_gain_to_split))
+        return jnp.where(ok, g, NEG_INF)
+
+    # ---- one-hot (one category vs rest) ------------------------------
+    use_onehot = (num_bin - 1) <= cfg.max_cat_to_onehot       # [F]
+    gain_oh = child_gain(hist[..., 0], hist[..., 1], cnt)
+    gain_oh = jnp.where(valid_bin & use_onehot[:, None], gain_oh, NEG_INF)
+
+    # ---- sorted many-vs-many -----------------------------------------
+    ratio = jnp.where(valid_bin,
+                      hist[..., 0] / (hist[..., 1] + cfg.cat_smooth),
+                      jnp.inf)
+    # two scan directions; invalid bins sort to the end in both
+    order_asc = jnp.argsort(ratio, axis=1)
+    order_desc = jnp.argsort(jnp.where(valid_bin, -ratio, jnp.inf), axis=1)
+    orders = jnp.stack([order_asc, order_desc], axis=1)       # [F, 2, B]
+    sorted_hist = jnp.take_along_axis(hist[:, None], orders[..., None],
+                                      axis=2)                 # [F, 2, B, 3]
+    sorted_valid = jnp.take_along_axis(valid_bin[:, None], orders, axis=2)
+    cum = jnp.cumsum(sorted_hist, axis=2)
+    prefix_ok = (jnp.cumprod(sorted_valid.astype(jnp.int32), axis=2) > 0)
+    k_idx = bin_idx[None]                                     # prefix len-1
+    gain_sorted = child_gain(cum[..., 0], cum[..., 1], cum[..., 2])
+    gain_sorted = jnp.where(
+        prefix_ok & (k_idx < cfg.max_cat_threshold)
+        & ~use_onehot[:, None, None] & cat_ok[:, None, None],
+        gain_sorted, NEG_INF)                                 # [F, 2, B]
+
+    # ---- pick the best candidate -------------------------------------
+    all_gain = jnp.concatenate(
+        [gain_oh[:, None, :], gain_sorted], axis=1)           # [F, 3, B]
+    flat = all_gain.reshape(-1)
+    best = jnp.argmax(flat)
+    best_gain = flat[best]
+    feature = (best // (3 * b)).astype(jnp.int32)
+    mode = ((best // b) % 3).astype(jnp.int32)                # 0=oh,1=asc,2=desc
+    j = (best % b).astype(jnp.int32)
+
+    onehot_inset = bin_idx[0] == j                            # [B]
+    order_w = orders[feature, jnp.maximum(mode - 1, 0)]       # [B]
+    inv = jnp.zeros(b, jnp.int32).at[order_w].set(
+        jnp.arange(b, dtype=jnp.int32))
+    sorted_inset = (inv <= j) & valid_bin[feature]
+    inset = jnp.where(mode == 0, onehot_inset, sorted_inset)
+
+    left_oh = hist[feature, j]
+    left_sorted = cum[feature, jnp.maximum(mode - 1, 0), j]
+    left_sums = jnp.where(mode == 0, left_oh, left_sorted)
+    return best_gain, feature, left_sums, inset
+
+
 def find_best_split(hist: jax.Array, parent_sums: jax.Array,
                     num_bin: jax.Array, has_nan: jax.Array,
                     allowed_feature: jax.Array,
-                    cfg: SplitConfig) -> Dict[str, jax.Array]:
+                    cfg: SplitConfig,
+                    is_cat: jax.Array = None) -> Dict[str, jax.Array]:
     """Best split for one leaf given its histogram.
 
     Args:
@@ -78,15 +186,24 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
       has_nan: ``[F]`` bool — whether the LAST used bin is the NaN bin.
       allowed_feature: ``[F]`` bool — column-sampling / interaction mask.
       cfg: static hyperparameters.
+      is_cat: ``[F]`` bool — categorical features (scanned by
+        ``_categorical_best`` instead of the threshold scan). Only read
+        when ``cfg.has_categorical``.
 
     Returns dict of scalars: ``gain`` (−inf if no valid split), ``feature``,
     ``threshold_bin`` (split sends ``bin <= t`` left), ``default_left``,
-    ``left_sums``/``right_sums`` (each ``[3]``).
+    ``left_sums``/``right_sums`` (each ``[3]``), ``is_cat`` (categorical
+    split?) and ``cat_bitset`` (``[ceil(B/32)]`` uint32 left-set over bins).
     """
     f, b, _ = hist.shape
+    n_words = (b + 31) // 32
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]          # [1, B]
     nan_bin = (num_bin - 1)[:, None]                           # [F, 1]
     is_nan_bin = has_nan[:, None] & (bin_idx == nan_bin)       # [F, B]
+
+    num_allowed = allowed_feature
+    if cfg.has_categorical and is_cat is not None:
+        num_allowed = allowed_feature & ~is_cat
 
     hist_vals = jnp.where(is_nan_bin[..., None], 0.0, hist)
     nan_sums = jnp.sum(jnp.where(is_nan_bin[..., None], hist, 0.0),
@@ -112,7 +229,7 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
     valid_t = bin_idx < (n_value_bins[:, None] - 1
                          + has_nan.astype(jnp.int32)[:, None])
     valid = (valid_t[:, :, None]
-             & allowed_feature[:, None, None]
+             & num_allowed[:, None, None]
              & (lc >= cfg.min_data_in_leaf) & (rc >= cfg.min_data_in_leaf)
              & (lh >= cfg.min_sum_hessian_in_leaf)
              & (rh >= cfg.min_sum_hessian_in_leaf)
@@ -125,9 +242,26 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
     feature = (best // (b * 2)).astype(jnp.int32)
     threshold_bin = ((best // 2) % b).astype(jnp.int32)
     default_left = (best % 2).astype(jnp.bool_)
-
     left_best = left[feature, threshold_bin,
                      default_left.astype(jnp.int32)]
+
+    if cfg.has_categorical and is_cat is not None:
+        cgain, cfeat, cleft, cinset = _categorical_best(
+            hist, parent_sums, num_bin, allowed_feature, is_cat, cfg)
+        take_cat = cgain > best_gain
+        best_gain = jnp.maximum(best_gain, cgain)
+        feature = jnp.where(take_cat, cfeat, feature)
+        threshold_bin = jnp.where(take_cat, 0, threshold_bin)
+        default_left = jnp.where(take_cat, False, default_left)
+        left_best = jnp.where(take_cat, cleft, left_best)
+        cat_bitset = jnp.where(take_cat,
+                               _pack_bitset(cinset, n_words),
+                               jnp.zeros(n_words, jnp.uint32))
+        is_cat_split = take_cat
+    else:
+        cat_bitset = jnp.zeros(n_words, jnp.uint32)
+        is_cat_split = jnp.array(False)
+
     right_best = parent_sums - left_best
     return {
         "gain": best_gain,
@@ -136,4 +270,6 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
         "default_left": default_left,
         "left_sums": left_best,
         "right_sums": right_best,
+        "is_cat": is_cat_split,
+        "cat_bitset": cat_bitset,
     }

@@ -32,10 +32,16 @@ class Tree:
     leaf_count: np.ndarray       # [num_leaves] int64
     leaf_weight: np.ndarray      # [num_leaves] float64
     shrinkage: float = 1.0
-    # categorical split support (filled when cat splits exist)
+    # categorical split support (filled when cat splits exist):
+    # value-level bitsets for raw-feature predict + model text (LightGBM
+    # layout: threshold_real[i] = cat idx; cat_boundaries[idx:idx+1]
+    # delimit this node's uint32 words in cat_threshold)
     cat_boundaries: Optional[np.ndarray] = None
     cat_threshold: Optional[np.ndarray] = None
     is_categorical: Optional[np.ndarray] = None
+    # bin-level bitsets in the device layout ([nn, W] uint32) for
+    # binned-matrix prediction (engine predict / score rebuild)
+    cat_bitset_bins: Optional[np.ndarray] = None
 
     @property
     def num_nodes(self) -> int:
@@ -57,12 +63,31 @@ class Tree:
         leaf = self._leaf_index_raw(X)
         return self.leaf_value[leaf]
 
+    def _cat_go_left(self, cat_idx: np.ndarray,
+                     vals: np.ndarray) -> np.ndarray:
+        """Vectorized category-value bitset membership (NaN/negative/
+        unseen values miss the set and go right)."""
+        ci = np.clip(cat_idx.astype(np.int64), 0,
+                     len(self.cat_boundaries) - 2)
+        start = self.cat_boundaries[ci]
+        nw = self.cat_boundaries[ci + 1] - start
+        iv = np.where(np.isfinite(vals) & (vals >= 0), vals, -1.0) \
+            .astype(np.int64)
+        w = iv >> 5
+        ok = (iv >= 0) & (w < nw)
+        word = self.cat_threshold[np.clip(start + w, 0,
+                                          len(self.cat_threshold) - 1)]
+        bit = (word >> (iv & 31).astype(np.uint32)) & np.uint32(1)
+        return ok & (bit > 0)
+
     def _leaf_index_raw(self, X: np.ndarray) -> np.ndarray:
         n = X.shape[0]
         node = np.zeros(n, dtype=np.int64)
         active = np.ones(n, dtype=bool) if self.num_leaves > 1 else \
             np.zeros(n, dtype=bool)
         out = np.zeros(n, dtype=np.int64)
+        has_cat = (self.is_categorical is not None
+                   and np.any(self.is_categorical))
         for _ in range(self.num_nodes + 1):
             if not active.any():
                 break
@@ -73,6 +98,10 @@ class Tree:
             dl = self.default_left[nd]
             miss = np.isnan(vals)
             go_left = np.where(miss, dl, vals <= thr)
+            if has_cat:
+                catn = self.is_categorical[nd]
+                go_left = np.where(catn, self._cat_go_left(thr, vals),
+                                   go_left)
             nxt = np.where(go_left, self.left_child[nd],
                            self.right_child[nd])
             at_leaf = nxt < 0
@@ -112,10 +141,44 @@ class Tree:
         nn = max(nl - 1, 0)
         sf = np.asarray(tree_arrays["split_feature"])[:nn].astype(np.int32)
         tb = np.asarray(tree_arrays["threshold_bin"])[:nn].astype(np.int32)
+        is_cat = None
+        cat_bs = None
+        cat_boundaries = None
+        cat_threshold = None
+        if "is_cat" in tree_arrays:
+            is_cat = np.asarray(tree_arrays["is_cat"])[:nn].astype(bool)
+            cat_bs = np.asarray(tree_arrays["cat_bitset"])[:nn] \
+                .astype(np.uint32)
+            if not is_cat.any():
+                is_cat = None
+                cat_bs = None
         tr = np.zeros(nn, dtype=np.float64)
+        bounds = [0]
+        words_all: list = []
         for i in range(nn):
             mapper = bin_mappers[used_features[int(sf[i])]]
-            tr[i] = mapper.bin_to_threshold(int(tb[i]))
+            if is_cat is not None and is_cat[i]:
+                # bin-level bitset -> category-VALUE bitset (LightGBM
+                # stores the raw category values, bin.h CategoricalBin)
+                bits = np.unpackbits(
+                    np.ascontiguousarray(cat_bs[i]).view(np.uint8),
+                    bitorder="little")
+                nb = len(mapper.bin_to_cat)
+                bins_in = np.flatnonzero(bits[:nb])
+                cats = mapper.bin_to_cat[bins_in]
+                cats = cats[cats >= 0]
+                nwords = (int(cats.max()) >> 5) + 1 if len(cats) else 1
+                words = np.zeros(nwords, dtype=np.uint32)
+                for v in cats:
+                    words[int(v) >> 5] |= np.uint32(1) << np.uint32(v & 31)
+                tr[i] = float(len(bounds) - 1)   # cat split index
+                words_all.extend(words)
+                bounds.append(len(words_all))
+            else:
+                tr[i] = mapper.bin_to_threshold(int(tb[i]))
+        if is_cat is not None:
+            cat_boundaries = np.asarray(bounds, dtype=np.int64)
+            cat_threshold = np.asarray(words_all, dtype=np.uint32)
         t = Tree(
             num_leaves=nl,
             split_feature=sf,
@@ -136,6 +199,10 @@ class Tree:
             .astype(np.int64),
             leaf_weight=np.asarray(tree_arrays["leaf_weight"])[:nl]
             .astype(np.float64),
+            cat_boundaries=cat_boundaries,
+            cat_threshold=cat_threshold,
+            is_categorical=is_cat,
+            cat_bitset_bins=cat_bs,
         )
         t.shrink(shrinkage)
         return t
