@@ -13,7 +13,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
 
-from .boosting.gbdt import GBDT
+from .boosting import GBDT, create_boosting
 from .config import Config
 from .io.dataset import Dataset
 from .utils import log
@@ -41,7 +41,7 @@ class Booster:
                         "use_missing", "zero_as_missing",
                         "data_random_seed"):
                 train_set.params.setdefault(key, getattr(self.config, key))
-            self._engine = GBDT(self.config, train_set)
+            self._engine = create_boosting(self.config, train_set)
             self.train_set = train_set
         elif model_file is not None or model_str is not None:
             from .io.model_text import load_model_string

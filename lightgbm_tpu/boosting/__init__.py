@@ -1,1 +1,20 @@
-"""Subpackage: boosting."""
+"""Boosting engines: GBDT (base), DART, RF.
+
+Reference: the Boosting factory (src/boosting/boosting.cpp
+Boosting::CreateBoosting, UNVERIFIED — empty mount, see SURVEY.md banner)
+dispatches on the ``boosting`` param; ``goss`` resolves to GBDT +
+data_sample_strategy=goss at config-fixup time (config.py).
+"""
+from .gbdt import GBDT
+
+__all__ = ["GBDT", "create_boosting"]
+
+
+def create_boosting(config, train_set, fobj=None, mesh=None) -> GBDT:
+    if config.boosting == "dart":
+        from .dart import DART
+        return DART(config, train_set, fobj=fobj, mesh=mesh)
+    if config.boosting == "rf":
+        from .rf import RandomForest
+        return RandomForest(config, train_set, fobj=fobj, mesh=mesh)
+    return GBDT(config, train_set, fobj=fobj, mesh=mesh)

@@ -1,0 +1,135 @@
+"""DART boosting: Dropouts meet Multiple Additive Regression Trees.
+
+Reference semantics: ``DART`` (src/boosting/dart.hpp, UNVERIFIED — empty
+mount, see SURVEY.md banner). Per iteration:
+
+1. select a random subset of existing iterations to *drop* (skipped
+   entirely with probability ``skip_drop``; per-iteration drop probability
+   ``drop_rate``, weighted by current tree weight unless ``uniform_drop``;
+   capped at ``max_drop``),
+2. compute gradients on the ensemble score *minus* the dropped trees'
+   contributions and train the new tree there,
+3. renormalize so the expected ensemble output is unchanged: the new tree
+   gets weight ``lr/(k+1)`` and each dropped tree is rescaled by
+   ``k/(k+1)`` (with ``xgboost_dart_mode``: ``lr/(k+lr)`` and
+   ``k/(k+lr)``, XGBoost's normalize_type=tree).
+
+TPU-first: the dropped-tree contributions are one stacked
+``forest_predict_binned`` on the device-resident binned matrix — no
+per-tree host loop — and the re-normalization is two fused elementwise
+score updates. The heavy per-iteration work (gradients + tree growth)
+reuses the jitted GBDT step unchanged.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..ops.predict import forest_predict_binned
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    """DART engine (reference: src/boosting/dart.hpp DART : public GBDT)."""
+
+    def __init__(self, config, train_set, fobj=None, mesh=None):
+        super().__init__(config, train_set, fobj=fobj, mesh=mesh)
+        self._rng_drop = np.random.RandomState(config.drop_seed)
+        self._iter_weights: List[float] = []   # current weight per iteration
+        self._sum_weight = 0.0
+
+    def can_fuse_iters(self) -> bool:
+        # drop selection / renormalization is host-orchestrated per iter
+        return False
+
+    # ------------------------------------------------------------------
+    def _select_drop(self) -> np.ndarray:
+        """DART::DroppingTrees — iteration indices to drop this round."""
+        c = self.config
+        n_iter = len(self._iter_weights)
+        if n_iter == 0 or self._rng_drop.rand() < c.skip_drop:
+            return np.array([], dtype=np.int64)
+        if c.uniform_drop:
+            p = np.full(n_iter, c.drop_rate)
+        else:
+            # weight-proportional drop, normalized so the mean probability
+            # is drop_rate (heavier trees are dropped more often)
+            w = np.asarray(self._iter_weights, dtype=np.float64)
+            mean_w = self._sum_weight / n_iter
+            p = c.drop_rate * w / max(mean_w, 1e-32)
+        drop = np.flatnonzero(self._rng_drop.rand(n_iter) < p)
+        if c.max_drop > 0 and len(drop) > c.max_drop:
+            drop = np.sort(self._rng_drop.choice(
+                drop, size=c.max_drop, replace=False))
+        return drop
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> None:
+        K = self.num_class
+        lr = float(self.config.learning_rate)
+        drop_iters = self._select_drop()
+        k = len(drop_iters)
+
+        drop_contrib = None
+        drop_contrib_valid = []
+        if k:
+            model_idx = [int(i) * K + c
+                         for i in drop_iters for c in range(K)]
+            stacked, class_idx = self._stack_model_list(model_idx)
+            drop_contrib, _ = forest_predict_binned(
+                stacked, self.data.bins, self.feat_num_bin,
+                self.feat_has_nan, class_idx, K)
+            self.score = self.score - drop_contrib
+            for vi, dd in enumerate(self.valid_data):
+                vc, _ = forest_predict_binned(
+                    stacked, dd.bins, self.feat_num_bin,
+                    self.feat_has_nan, class_idx, K)
+                drop_contrib_valid.append(vc)
+                self.valid_scores[vi] = self.valid_scores[vi] - vc
+
+        score_pre = self.score
+        valid_pre = list(self.valid_scores)
+        super().train_one_iter(grad, hess)
+
+        if k == 0:
+            self._iter_weights.append(lr)
+            self._sum_weight += lr
+            return
+
+        if self.config.xgboost_dart_mode:
+            # XGBoost normalize_type=tree: new weight lr/(k+lr)
+            new_mult = 1.0 / (k + lr)       # vs the lr already applied
+            old_mult = k / (k + lr)
+        else:
+            new_mult = 1.0 / (k + 1.0)
+            old_mult = k / (k + 1.0)
+
+        # score = score_pre + new_mult * (new tree's lr-scaled output)
+        #                   + old_mult * (dropped trees' old contribution)
+        self.score = (score_pre + (self.score - score_pre) * new_mult
+                      + drop_contrib * old_mult)
+        for vi in range(len(self.valid_scores)):
+            self.valid_scores[vi] = (
+                valid_pre[vi]
+                + (self.valid_scores[vi] - valid_pre[vi]) * new_mult
+                + drop_contrib_valid[vi] * old_mult)
+
+        # host-side tree bookkeeping mirrors the score math
+        for t in self.models[-K:]:
+            t.shrink(new_mult)
+        for i in drop_iters:
+            for c in range(K):
+                self.models[int(i) * K + c].shrink(old_mult)
+            self._iter_weights[int(i)] *= old_mult
+        self._iter_weights.append(lr * new_mult)
+        self._sum_weight = float(np.sum(self._iter_weights))
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        if self.iter_ and self._iter_weights:
+            # NOTE: the dropped-tree rescales of the rolled-back iteration
+            # are kept (the reference rolls back only the new trees too)
+            self._sum_weight -= self._iter_weights.pop()
+        super().rollback_one_iter()

@@ -127,6 +127,7 @@ class GBDT:
         self.num_class = config.num_tree_per_iteration
         self.models: List[Tree] = []
         self.iter_ = 0
+        self.average_output = False  # RF subclass sets True
 
         n_shards = self.mesh.devices.size if self.mesh is not None else 1
         rows_per_block = min(
@@ -209,6 +210,10 @@ class GBDT:
         # valid-set count changed: the valid_update jit closure must see it
         self._build_step()
 
+    def _learning_rate(self) -> float:
+        """Per-tree shrinkage; RF overrides to 1.0 (rf.hpp stores raw)."""
+        return float(self.config.learning_rate)
+
     def _make_grow_cfg(self) -> GrowConfig:
         config = self.config
         return GrowConfig(
@@ -241,7 +246,7 @@ class GBDT:
         # re-derive growth config so reset_parameter takes effect
         self.grow_cfg = self._make_grow_cfg()
         gcfg = self.grow_cfg
-        lr = float(self.config.learning_rate)
+        lr = self._learning_rate()
         mesh = self.mesh
 
         needs_rng = getattr(obj, "needs_rng", False)
@@ -618,7 +623,7 @@ class GBDT:
         for k in range(self.num_class):
             arrays = {key: v[k] for key, v in host.items()}
             self.models.append(Tree.from_device(
-                arrays, self.config.learning_rate,
+                arrays, self._learning_rate(),
                 self.train_set.bin_mappers, self.train_set.used_features))
 
     def can_fuse_iters(self) -> bool:
@@ -744,7 +749,12 @@ class GBDT:
     # ------------------------------------------------------------------
     def _stack_models(self, start: int, num: int):
         """Stack host trees [start, start+num) into device arrays."""
-        trees = self.models[start:start + num]
+        return self._stack_model_list(list(range(start, start + num)))
+
+    def _stack_model_list(self, indices: List[int]):
+        """Stack an arbitrary subset of host trees into device arrays
+        (DART needs non-contiguous dropped-tree subsets)."""
+        trees = [self.models[i] for i in indices]
         L = max((t.num_leaves for t in trees), default=1)
         Ln = max(L - 1, 1)
 
@@ -779,7 +789,7 @@ class GBDT:
                            else np.zeros(t.num_nodes, bool)), Ln, bool)
             stacked["cat_bitset"] = jnp.asarray(bs)
         class_idx = jnp.asarray(
-            np.arange(start, start + num, dtype=np.int32) % self.num_class)
+            np.asarray(indices, dtype=np.int32) % self.num_class)
         return stacked, class_idx
 
     # ------------------------------------------------------------------
@@ -843,7 +853,10 @@ class GBDT:
             if pred_leaf:
                 return np.asarray(leaves).T.astype(np.int32)
             raw = np.asarray(raw_dev, dtype=np.float64)
-            if start_iteration == 0:
+            if self.average_output:
+                # RF: trees carry the init-score bias; average them
+                raw = raw / num_iteration
+            elif start_iteration == 0:
                 raw = raw + self.init_scores[None, :]
         if pred_leaf:
             return np.zeros((n, 0), dtype=np.int32)

@@ -1,0 +1,128 @@
+"""Random-forest mode (``boosting=rf``).
+
+Reference semantics: ``RF`` (src/boosting/rf.hpp, UNVERIFIED — empty
+mount, see SURVEY.md banner): trees are trained *independently* — the
+gradients are always evaluated at the constant init score, never at the
+boosted ensemble score — each on its own bagging subset (bagging is
+mandatory), stored UNSHRUNK with the per-class init score folded into
+every tree's leaves, and the ensemble output is the AVERAGE of tree
+outputs (``average_output`` in the model text).
+
+TPU-first: reuses the jitted GBDT step verbatim — only the score fed to
+the gradient computation (the constant init tile) and the host-side
+averaging bookkeeping differ. The displayed train/valid scores are
+maintained incrementally as ``base + pred_sum / n_iter`` so metrics see
+the averaged forest at every iteration.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.predict import forest_predict_binned
+from ..utils import log
+from .gbdt import GBDT
+
+
+class RandomForest(GBDT):
+    """RF engine (reference: src/boosting/rf.hpp RF : public GBDT)."""
+
+    def __init__(self, config, train_set, fobj=None, mesh=None):
+        use_bagging = (config.bagging_freq > 0
+                       and (config.bagging_fraction < 1.0
+                            or config.pos_bagging_fraction < 1.0
+                            or config.neg_bagging_fraction < 1.0))
+        if not use_bagging:
+            log.fatal("Random forest needs bagging: set bagging_freq > 0 "
+                      "and bagging_fraction < 1.0")
+        if config.data_sample_strategy == "goss":
+            log.fatal("Cannot use GOSS with random forest")
+        super().__init__(config, train_set, fobj=fobj, mesh=mesh)
+        self.average_output = True
+        # constant gradient point: init score tile (+ dataset init_score)
+        self._score0 = self.score
+        self._s0 = jnp.asarray(self.init_scores.astype(np.float32))[None, :]
+        self._base = self._score0 - self._s0   # dataset init_score offset
+        self._pred_sum = jnp.zeros_like(self.score)  # sum of biased preds
+        self._valid_base: List[jnp.ndarray] = []
+        self._valid_pred_sum: List[jnp.ndarray] = []
+
+    def _learning_rate(self) -> float:
+        return 1.0  # rf.hpp: no shrinkage, trees stored raw
+
+    def can_fuse_iters(self) -> bool:
+        return False  # bagging re-draw + averaging are host-orchestrated
+
+    # ------------------------------------------------------------------
+    def add_valid(self, ds, name: str) -> None:
+        super().add_valid(ds, name)
+        vi = len(self.valid_data) - 1
+        dd = self.valid_data[vi]
+        full = self.valid_scores[vi]   # v0 + sum of (biased) stored trees
+        v0 = np.tile(self.init_scores.astype(np.float32), (dd.n_pad, 1))
+        if dd.init_score is not None:
+            v0[:dd.n] += dd.init_score.reshape(dd.n, -1).astype(np.float32)
+        v0 = dd._place(v0, extra_dims=2)
+        base = v0 - self._s0
+        pred_sum = full - v0
+        self._valid_base.append(base)
+        self._valid_pred_sum.append(pred_sum)
+        n = max(self.iter_, 1)
+        self.valid_scores[vi] = (base + pred_sum / n if self.iter_
+                                 else v0)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> None:
+        K = self.num_class
+        saved_valid = self.valid_scores
+        self.valid_scores = []          # skip the base valid-score update
+        self.score = self._score0       # gradients at the constant init
+        super().train_one_iter(grad, hess)
+        pred = self.score - self._score0   # this iteration's raw outputs
+        self.valid_scores = saved_valid
+        n = self.iter_
+
+        # fold the init score into the stored trees (rf.hpp AddBias) so
+        # the averaged model output carries the bias
+        for c in range(K):
+            t = self.models[-K + c]
+            bias = float(self.init_scores[c])
+            t.leaf_value = t.leaf_value + bias
+            t.internal_value = t.internal_value + bias
+
+        self._pred_sum = self._pred_sum + pred + self._s0
+        self.score = self._base + self._pred_sum / n
+
+        if self.valid_data:
+            stacked, class_idx = self._stack_model_list(
+                list(range(len(self.models) - K, len(self.models))))
+            for vi, dd in enumerate(self.valid_data):
+                raw, _ = forest_predict_binned(
+                    stacked, dd.bins, self.feat_num_bin,
+                    self.feat_has_nan, class_idx, K)
+                self._valid_pred_sum[vi] = self._valid_pred_sum[vi] + raw
+                self.valid_scores[vi] = (self._valid_base[vi]
+                                         + self._valid_pred_sum[vi] / n)
+
+    # ------------------------------------------------------------------
+    def _recompute_scores(self) -> None:
+        super()._recompute_scores()
+        n = self.iter_
+        if n == 0:
+            self._pred_sum = jnp.zeros_like(self.score)
+            self.score = self._score0
+            for vi in range(len(self.valid_scores)):
+                self._valid_pred_sum[vi] = jnp.zeros_like(
+                    self.valid_scores[vi])
+                self.valid_scores[vi] = self._valid_base[vi] + self._s0
+            return
+        self._pred_sum = self.score - self._score0
+        self.score = self._base + self._pred_sum / n
+        for vi in range(len(self.valid_scores)):
+            v0 = self._valid_base[vi] + self._s0
+            self._valid_pred_sum[vi] = self.valid_scores[vi] - v0
+            self.valid_scores[vi] = (self._valid_base[vi]
+                                     + self._valid_pred_sum[vi] / n)

@@ -66,8 +66,9 @@ class HostModel:
                 # categorical missing routes via bitset-miss, not the
                 # numerical default-direction machinery
                 mt[t2.is_categorical[:len(mt)]] = 0
-            if ti < engine.num_class:
-                # fold init score into the first iteration's trees (AddBias)
+            if ti < engine.num_class and not engine.average_output:
+                # fold init score into the first iteration's trees
+                # (AddBias); RF trees already carry the bias per-tree
                 bias = float(engine.init_scores[ti % engine.num_class])
                 t2.leaf_value = t2.leaf_value + bias
                 t2.internal_value = t2.internal_value + bias
@@ -105,7 +106,7 @@ class HostModel:
             feature_names=list(ds.feature_names),
             feature_infos=infos,
             max_feature_idx=ds.num_total_features - 1,
-            average_output=(config.boosting == "rf"),
+            average_output=engine.average_output,
             params={"objective": obj, "num_leaves": config.num_leaves,
                     "learning_rate": config.learning_rate,
                     "max_bin": config.max_bin,
@@ -172,6 +173,10 @@ class HostModel:
         out = np.zeros((n, K, n_feat + 1), dtype=np.float64)
         for i, t in enumerate(trees):
             out[:, i % K, :] += tree_shap_batch(t, X, n_feat)
+        if self.average_output and len(trees):
+            # RF: contributions average like the prediction does, keeping
+            # the SHAP local-accuracy invariant sum(contrib) == raw pred
+            out /= (len(trees) // K)
         if K == 1:
             return out[:, 0, :]
         return out.reshape(n, K * (n_feat + 1))

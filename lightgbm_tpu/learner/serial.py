@@ -366,6 +366,18 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         depth2 = s.leaf_depth[tl_safe] + 1
         lvals = leaf_out(lsums)
         rvals = leaf_out(rsums)
+        if cfg.has_categorical:
+            # children of a categorical split are regularized with
+            # lambda_l2 + cat_l2, matching the gain computed in
+            # ops/split.py (reference: feature_histogram.hpp categorical
+            # CalculateSplittedLeafOutput uses the cat-augmented l2)
+            def leaf_out_cat(sums):
+                return calc_leaf_output(
+                    sums[..., 0], sums[..., 1], cfg.lambda_l1,
+                    cfg.lambda_l2 + cfg.cat_l2, cfg.max_delta_step)
+            cat_split = s.best_is_cat[tl_safe]
+            lvals = jnp.where(cat_split, leaf_out_cat(lsums), lvals)
+            rvals = jnp.where(cat_split, leaf_out_cat(rsums), rvals)
 
         # ---- tree wiring -----------------------------------------------
         lc = s.left_child.at[node_ids].set(-top_leaf - 1)

@@ -1,0 +1,158 @@
+"""DART and RF boosting modes (reference: src/boosting/dart.hpp, rf.hpp
+semantics; test style mirrors reference test_engine.py's mode tests)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=4000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    logit = X @ w + 0.5 * X[:, 0] * X[:, 1]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _regression_data(n=3000, f=8, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = X @ w + np.sin(2 * X[:, 0]) + rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# DART
+# ---------------------------------------------------------------------------
+def test_dart_trains_and_predicts():
+    X, y = _binary_data()
+    ds = lgb.Dataset(X[:3000], label=y[:3000])
+    vs = ds.create_valid(X[3000:], label=y[3000:])
+    res = {}
+    bst = lgb.train(
+        {"objective": "binary", "boosting": "dart", "num_leaves": 31,
+         "drop_rate": 0.3, "skip_drop": 0.25, "metric": "auc",
+         "verbosity": -1}, ds, num_boost_round=30, valid_sets=[vs],
+        callbacks=[lgb.record_evaluation(res)])
+    auc = res["valid_0"]["auc"][-1]
+    assert auc > 0.9
+    # eval-score and predict() agree: the per-iteration renormalization
+    # bookkeeping (device scores vs host tree shrinks) is consistent
+    pred = bst.predict(X[3000:], raw_score=True)
+    from lightgbm_tpu.metric import AUCMetric
+    from lightgbm_tpu.config import Config
+    auc2 = AUCMetric(Config({})).eval(pred, y[3000:], None)[0][1]
+    assert abs(auc - auc2) < 1e-5
+
+
+def test_dart_score_matches_stored_trees():
+    """Internal train score == sum of stored (renormalized) trees."""
+    X, y = _regression_data(n=1500)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "boosting": "dart", "num_leaves": 15,
+         "drop_rate": 0.5, "skip_drop": 0.0, "uniform_drop": True,
+         "verbosity": -1}, ds, num_boost_round=15)
+    eng = bst.engine
+    internal = np.asarray(eng.score)[:eng.data.n, 0]
+    manual = np.full(len(y), eng.init_scores[0])
+    for t in eng.models:
+        manual += t.predict_raw(X[:, eng.train_set.used_features])
+    np.testing.assert_allclose(internal, manual, rtol=2e-4, atol=2e-4)
+
+
+def test_dart_xgboost_mode():
+    X, y = _binary_data(n=2000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "binary", "boosting": "dart", "num_leaves": 15,
+         "xgboost_dart_mode": True, "drop_rate": 0.3, "skip_drop": 0.0,
+         "verbosity": -1}, ds, num_boost_round=10)
+    pred = bst.predict(X)
+    assert pred.shape == (2000,)
+    assert np.all((pred >= 0) & (pred <= 1))
+
+
+def test_dart_model_text_roundtrip():
+    X, y = _regression_data(n=1200)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "boosting": "dart", "num_leaves": 15,
+         "drop_rate": 0.4, "skip_drop": 0.1, "verbosity": -1}, ds,
+        num_boost_round=12)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# RF
+# ---------------------------------------------------------------------------
+def test_rf_requires_bagging():
+    X, y = _binary_data(n=500)
+    ds = lgb.Dataset(X, label=y)
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train({"objective": "binary", "boosting": "rf",
+                   "verbosity": -1}, ds, num_boost_round=2)
+
+
+def test_rf_trains_and_averages():
+    X, y = _binary_data()
+    ds = lgb.Dataset(X[:3000], label=y[:3000])
+    vs = ds.create_valid(X[3000:], label=y[3000:])
+    res = {}
+    bst = lgb.train(
+        {"objective": "binary", "boosting": "rf", "num_leaves": 63,
+         "bagging_freq": 1, "bagging_fraction": 0.6,
+         "feature_fraction": 0.8, "metric": "auc", "verbosity": -1},
+        ds, num_boost_round=20, valid_sets=[vs],
+        callbacks=[lgb.record_evaluation(res)])
+    auc = res["valid_0"]["auc"][-1]
+    assert auc > 0.88
+    # predict() averages: raw score bounded by the deepest single tree,
+    # not growing with the number of trees
+    raw = bst.predict(X[3000:], raw_score=True)
+    pred = bst.predict(X[3000:])
+    from lightgbm_tpu.metric import AUCMetric
+    from lightgbm_tpu.config import Config
+    auc2 = AUCMetric(Config({})).eval(pred, y[3000:], None)[0][1]
+    assert abs(auc - auc2) < 1e-5
+    # averaged output equals the mean of per-tree predictions (host check)
+    eng = bst.engine
+    Xu = X[3000:][:, eng.train_set.used_features]
+    manual = np.mean([t.predict_raw(Xu) for t in eng.models], axis=0)
+    np.testing.assert_allclose(raw, manual, rtol=2e-4, atol=2e-4)
+
+
+def test_rf_trees_independent_of_order():
+    """RF gradients are evaluated at the constant init score, so every
+    tree fits the full target — not a residual: later trees have the
+    same output scale as early trees."""
+    X, y = _regression_data(n=1500)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "boosting": "rf", "num_leaves": 31,
+         "bagging_freq": 1, "bagging_fraction": 0.7, "verbosity": -1},
+        ds, num_boost_round=10)
+    eng = bst.engine
+    Xu = X[:, eng.train_set.used_features]
+    spans = [np.std(t.predict_raw(Xu)) for t in eng.models]
+    # in boosted GBDT spans decay sharply; in RF they stay comparable
+    assert spans[-1] > 0.5 * spans[0]
+
+
+def test_rf_model_text_roundtrip_average_output():
+    X, y = _binary_data(n=1500)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+         "bagging_freq": 1, "bagging_fraction": 0.7, "verbosity": -1},
+        ds, num_boost_round=8)
+    s = bst.model_to_string()
+    assert "average_output" in s
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-5, atol=1e-5)

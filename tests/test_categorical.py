@@ -6,7 +6,6 @@ categories sort by grad/(hess+cat_smooth) and scan sorted prefixes from
 both directions; decisions are bitset membership over category values.
 """
 import numpy as np
-import pytest
 
 import lightgbm_tpu as lgb
 
@@ -113,7 +112,6 @@ def test_max_cat_threshold_limits_group_size():
     bst = lgb.train(params, lgb.Dataset(X, label=y,
                                         categorical_feature=[0]),
                     num_boost_round=1)
-    m = bst.dump_text() if hasattr(bst, "dump_text") else None
     s = bst.model_to_string()
     # every cat node's bitset has at most 2 set bits
     import re
