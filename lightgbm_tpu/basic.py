@@ -26,7 +26,8 @@ class Booster:
     def __init__(self, params: Optional[Dict[str, Any]] = None,
                  train_set: Optional[Dataset] = None,
                  model_file: Optional[str] = None,
-                 model_str: Optional[str] = None):
+                 model_str: Optional[str] = None,
+                 init_forest=None):
         self.params = dict(params or {})
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}
@@ -41,7 +42,8 @@ class Booster:
                         "use_missing", "zero_as_missing",
                         "data_random_seed"):
                 train_set.params.setdefault(key, getattr(self.config, key))
-            self._engine = create_boosting(self.config, train_set)
+            self._engine = create_boosting(self.config, train_set,
+                                           init_forest=init_forest)
             self.train_set = train_set
         elif model_file is not None or model_str is not None:
             from .io.model_text import load_model_string
@@ -237,6 +239,109 @@ class Booster:
         if importance_type == "split":
             return imp.astype(np.int64)
         return imp
+
+    def _refit_config(self) -> Config:
+        """Config for refit: user params, falling back to the loaded
+        model's stored objective when params don't name one."""
+        params = dict(self.params)
+        has_obj = any(Config.canonical_name(k) == "objective"
+                      for k in params)
+        if not has_obj:
+            hm = (self._from_model if self._from_model is not None
+                  else self._to_host_model())
+            toks = hm.objective_str.split()
+            if toks:
+                params["objective"] = toks[0]
+                for t in toks[1:]:
+                    k, _, v = t.partition(":")
+                    if k in ("sigmoid", "num_class"):
+                        params[k] = float(v) if k == "sigmoid" else int(v)
+        return Config(params)
+
+    def refit(self, data, label, weight=None, group=None,
+              decay_rate: Optional[float] = None, **_kwargs) -> "Booster":
+        """Refit the existing tree STRUCTURES' leaf values on new data
+        (GBDT::RefitTree, src/boosting/gbdt.cpp, UNVERIFIED): boost
+        sequentially from the init score — per iteration, compute
+        gradients at the current refitted score, re-derive each leaf's
+        optimal output from the rows it receives, blend ``decay_rate *
+        old + (1 - decay_rate) * new``, and add the refitted tree to the
+        score before the next iteration. Returns a new (prediction-only)
+        Booster."""
+        from .io.model_text import load_model_string, save_model_string
+        from .objective import create_objective
+        from .ops.split import calc_leaf_output
+        import jax
+        import jax.numpy as jnp
+        cfg = self._refit_config()
+        if decay_rate is None:
+            decay_rate = cfg.refit_decay_rate
+        hm = load_model_string(self.model_to_string())  # deep copy
+        X = Dataset._to_matrix(data)
+        label = np.asarray(label, dtype=np.float64)
+        n = len(X)
+        K = max(hm.num_tree_per_iteration, 1)
+        obj = create_objective(cfg)
+        if hasattr(obj, "prepare"):
+            obj.prepare(label, weight)
+        if obj.is_ranking:
+            if group is None:
+                raise LightGBMError("refit on a ranking objective needs "
+                                    "the group argument")
+            qb = np.concatenate([[0], np.cumsum(np.asarray(group))])
+            obj.setup_queries(qb.astype(np.int64), n)
+        # boost-from-average on the NEW data (the refit booster in the
+        # reference is constructed fresh on the new dataset). The stored
+        # model folds the bias into the first iteration's leaves, so the
+        # running score is the plain sum of STORED leaf values; s0 only
+        # seeds the gradient point before tree 0 exists.
+        s0 = np.zeros(K)
+        if K == 1:
+            s0[0] = obj.init_score(label, weight)
+        score = np.zeros((n, K))
+        w_dev = None if weight is None else jnp.asarray(weight)
+        label_dev = jnp.asarray(label)
+        num_iters = len(hm.trees) // K
+        leaf_idx = [t.predict_leaf_raw(X) for t in hm.trees]
+        for it in range(num_iters):
+            if hm.average_output:
+                # RF: every tree is independent — gradients at init,
+                # each tree carries its own bias
+                grad_point = np.tile(s0, (n, 1))
+            elif it == 0:
+                grad_point = np.tile(s0, (n, 1))
+            else:
+                grad_point = score
+            sc = jnp.asarray(grad_point[:, 0] if K == 1 else grad_point)
+            if getattr(obj, "needs_rng", False):
+                g, h = obj.get_gradients(sc, label_dev, w_dev,
+                                         key=jax.random.PRNGKey(it))
+            else:
+                g, h = obj.get_gradients(sc, label_dev, w_dev)
+            g = np.asarray(g).reshape(n, -1)
+            h = np.asarray(h).reshape(n, -1)
+            for k in range(K):
+                t = hm.trees[it * K + k]
+                leaf = leaf_idx[it * K + k]
+                nl = t.num_leaves
+                gs = np.bincount(leaf, weights=g[:, k], minlength=nl)[:nl]
+                hs = np.bincount(leaf, weights=h[:, k], minlength=nl)[:nl]
+                cnt = np.bincount(leaf, minlength=nl)[:nl]
+                new_out = np.asarray(calc_leaf_output(
+                    jnp.asarray(gs), jnp.asarray(hs), cfg.lambda_l1,
+                    cfg.lambda_l2, cfg.max_delta_step)) * t.shrinkage
+                if hm.average_output or it == 0:
+                    # keep the file self-contained: bias in iteration-0
+                    # leaves (AddBias), or in every leaf for RF
+                    new_out = new_out + s0[k]
+                # leaves with no rows in the new data keep their old value
+                new_out = np.where(cnt > 0, new_out, t.leaf_value)
+                t.leaf_value = (decay_rate * t.leaf_value
+                                + (1.0 - decay_rate) * new_out)
+                t.leaf_count = cnt.astype(np.int64)
+                score[:, k] += t.leaf_value[leaf]
+        return Booster(params=self.params,
+                       model_str=save_model_string(hm))
 
     def free_dataset(self) -> "Booster":
         return self

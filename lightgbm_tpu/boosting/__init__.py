@@ -10,11 +10,15 @@ from .gbdt import GBDT
 __all__ = ["GBDT", "create_boosting"]
 
 
-def create_boosting(config, train_set, fobj=None, mesh=None) -> GBDT:
+def create_boosting(config, train_set, fobj=None, mesh=None,
+                    init_forest=None) -> GBDT:
     if config.boosting == "dart":
         from .dart import DART
-        return DART(config, train_set, fobj=fobj, mesh=mesh)
+        return DART(config, train_set, fobj=fobj, mesh=mesh,
+                    init_forest=init_forest)
     if config.boosting == "rf":
         from .rf import RandomForest
-        return RandomForest(config, train_set, fobj=fobj, mesh=mesh)
-    return GBDT(config, train_set, fobj=fobj, mesh=mesh)
+        return RandomForest(config, train_set, fobj=fobj, mesh=mesh,
+                            init_forest=init_forest)
+    return GBDT(config, train_set, fobj=fobj, mesh=mesh,
+                init_forest=init_forest)

@@ -33,11 +33,19 @@ from .gbdt import GBDT
 class DART(GBDT):
     """DART engine (reference: src/boosting/dart.hpp DART : public GBDT)."""
 
-    def __init__(self, config, train_set, fobj=None, mesh=None):
-        super().__init__(config, train_set, fobj=fobj, mesh=mesh)
+    def __init__(self, config, train_set, fobj=None, mesh=None,
+                 init_forest=None):
+        super().__init__(config, train_set, fobj=fobj, mesh=mesh,
+                         init_forest=init_forest)
         self._rng_drop = np.random.RandomState(config.drop_seed)
         self._iter_weights: List[float] = []   # current weight per iteration
         self._sum_weight = 0.0
+        if self.iter_:
+            # continuation: the loaded trees' DART weights are unknown;
+            # seed each at lr (only affects non-uniform drop probabilities)
+            lr = float(config.learning_rate)
+            self._iter_weights = [lr] * self.iter_
+            self._sum_weight = lr * self.iter_
 
     def can_fuse_iters(self) -> bool:
         # drop selection / renormalization is host-orchestrated per iter

@@ -100,7 +100,8 @@ class GBDT:
     """Boosting engine (reference: GBDT class, src/boosting/gbdt.cpp)."""
 
     def __init__(self, config: Config, train_set: Dataset,
-                 fobj: Optional[Callable] = None, mesh=None):
+                 fobj: Optional[Callable] = None, mesh=None,
+                 init_forest=None):
         self.config = config
         self.train_set = train_set.construct()
         self.fobj = fobj
@@ -165,18 +166,19 @@ class GBDT:
         self.grow_cfg = self._make_grow_cfg()
 
         # ---- initial scores (BoostFromAverage, gbdt.cpp) ------------------
+        # Under continuation (init_model, gbdt.cpp::ResetTrainingData with
+        # existing models) the loaded forest carries the original init
+        # bias in its first trees, so boost-from-average is skipped.
         label_np = self.train_set.metadata.label
         self.init_scores = np.zeros(self.num_class, dtype=np.float64)
-        if label_np is not None and self.fobj is None:
+        if label_np is not None and self.fobj is None \
+                and init_forest is None:
             if self.num_class == 1:
                 self.init_scores[0] = self.objective.init_score(
                     label_np, self.train_set.metadata.weight)
-        score0 = np.tile(self.init_scores.astype(np.float32),
-                         (self.data.n_pad, 1))
-        if self.data.init_score is not None:
-            isc = self.data.init_score.reshape(self.data.n, -1)
-            score0[:self.data.n] += isc.astype(np.float32)
-        self.score = self.data._place(score0, extra_dims=2)
+        self.score = self._init_score_tile(self.data)
+        if init_forest is not None:
+            self._load_forest(init_forest)
 
         # valid sets registered later via add_valid
         self.valid_data: List[_DeviceData] = []
@@ -191,21 +193,54 @@ class GBDT:
         self._build_step()
 
     # ------------------------------------------------------------------
+    def _init_score_tile(self, dd: "_DeviceData") -> jnp.ndarray:
+        """Device [n_pad, K] tile of init scores + dataset init_score."""
+        s0 = np.tile(self.init_scores.astype(np.float32), (dd.n_pad, 1))
+        if dd.init_score is not None:
+            s0[:dd.n] += dd.init_score.reshape(dd.n, -1).astype(np.float32)
+        return dd._place(s0, extra_dims=2)
+
+    def _load_forest(self, init_forest) -> None:
+        """Continuation: adopt a loaded HostModel's trees and fold their
+        predictions into the training score."""
+        if init_forest.num_tree_per_iteration != self.num_class:
+            log.fatal(
+                f"Cannot continue training: the loaded model has "
+                f"{init_forest.num_tree_per_iteration} trees per iteration"
+                f", the new config {self.num_class}")
+        # NB: compare against the config, not self.average_output — the
+        # RF subclass sets that flag only after super().__init__ returns
+        if bool(init_forest.average_output) != (self.config.boosting
+                                                == "rf"):
+            kind = "averaged (rf)" if init_forest.average_output \
+                else "additive (gbdt/dart)"
+            log.fatal(
+                f"Cannot continue training: the loaded model is {kind} "
+                f"but boosting={self.config.boosting} — the ensemble "
+                f"semantics don't compose")
+        for ht in init_forest.trees:
+            self.models.append(Tree.rebin(
+                ht, self.train_set.bin_mappers,
+                self.train_set.used_features))
+        self.iter_ = len(self.models) // self.num_class
+        if self.models:
+            stacked, class_idx = self._stack_models(0, len(self.models))
+            raw, _ = forest_predict_binned(
+                stacked, self.data.bins, self.feat_num_bin,
+                self.feat_has_nan, class_idx, self.num_class)
+            self.score = self.score + raw
+
     def add_valid(self, ds: Dataset, name: str) -> None:
         dd = _DeviceData(ds.construct(), self.rows_per_block, self.mesh)
-        score0 = np.tile(self.init_scores.astype(np.float32),
-                         (dd.n_pad, 1))
-        if dd.init_score is not None:
-            score0[:dd.n] += dd.init_score.reshape(dd.n, -1)\
-                .astype(np.float32)
+        score0 = self._init_score_tile(dd)
         if self.models:
             stacked, class_idx = self._stack_models(0, len(self.models))
             raw, _ = forest_predict_binned(
                 stacked, dd.bins, self.feat_num_bin, self.feat_has_nan,
                 class_idx, self.num_class)
-            score0 = score0 + np.asarray(raw)
+            score0 = score0 + raw
         self.valid_data.append(dd)
-        self.valid_scores.append(dd._place(score0, extra_dims=2))
+        self.valid_scores.append(score0)
         self.valid_names.append(name)
         # valid-set count changed: the valid_update jit closure must see it
         self._build_step()
@@ -719,12 +754,7 @@ class GBDT:
         self._recompute_scores()
 
     def _recompute_scores(self) -> None:
-        score0 = np.tile(self.init_scores.astype(np.float32),
-                         (self.data.n_pad, 1))
-        if self.data.init_score is not None:
-            score0[:self.data.n] += self.data.init_score.reshape(
-                self.data.n, -1).astype(np.float32)
-        score = jnp.asarray(score0)
+        score = self._init_score_tile(self.data)
         if self.models:
             stacked, class_idx = self._stack_models(0, len(self.models))
             raw, _ = forest_predict_binned(
@@ -733,12 +763,7 @@ class GBDT:
             score = score + raw
         self.score = score
         for vi, dd in enumerate(self.valid_data):
-            v0 = np.tile(self.init_scores.astype(np.float32),
-                         (dd.n_pad, 1))
-            if dd.init_score is not None:
-                v0[:dd.n] += dd.init_score.reshape(dd.n, -1)\
-                    .astype(np.float32)
-            v = jnp.asarray(v0)
+            v = self._init_score_tile(dd)
             if self.models:
                 raw, _ = forest_predict_binned(
                     stacked, dd.bins, self.feat_num_bin, self.feat_has_nan,

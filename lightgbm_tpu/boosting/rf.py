@@ -29,7 +29,8 @@ from .gbdt import GBDT
 class RandomForest(GBDT):
     """RF engine (reference: src/boosting/rf.hpp RF : public GBDT)."""
 
-    def __init__(self, config, train_set, fobj=None, mesh=None):
+    def __init__(self, config, train_set, fobj=None, mesh=None,
+                 init_forest=None):
         use_bagging = (config.bagging_freq > 0
                        and (config.bagging_fraction < 1.0
                             or config.pos_bagging_fraction < 1.0
@@ -39,13 +40,21 @@ class RandomForest(GBDT):
                       "and bagging_fraction < 1.0")
         if config.data_sample_strategy == "goss":
             log.fatal("Cannot use GOSS with random forest")
-        super().__init__(config, train_set, fobj=fobj, mesh=mesh)
+        super().__init__(config, train_set, fobj=fobj, mesh=mesh,
+                         init_forest=init_forest)
         self.average_output = True
-        # constant gradient point: init score tile (+ dataset init_score)
-        self._score0 = self.score
+        # constant gradient point: init score tile (+ dataset init_score).
+        # Under continuation init_scores are zero (the bias lives in the
+        # loaded trees), and self.score currently holds score0 + forest
+        # sum — recover both pieces.
+        self._score0 = self._init_score_tile(self.data)
         self._s0 = jnp.asarray(self.init_scores.astype(np.float32))[None, :]
         self._base = self._score0 - self._s0   # dataset init_score offset
-        self._pred_sum = jnp.zeros_like(self.score)  # sum of biased preds
+        self._pred_sum = self.score - self._score0  # sum of biased preds
+        if self.iter_:
+            self.score = self._base + self._pred_sum / self.iter_
+        else:
+            self.score = self._score0
         self._valid_base: List[jnp.ndarray] = []
         self._valid_pred_sum: List[jnp.ndarray] = []
 
@@ -61,10 +70,7 @@ class RandomForest(GBDT):
         vi = len(self.valid_data) - 1
         dd = self.valid_data[vi]
         full = self.valid_scores[vi]   # v0 + sum of (biased) stored trees
-        v0 = np.tile(self.init_scores.astype(np.float32), (dd.n_pad, 1))
-        if dd.init_score is not None:
-            v0[:dd.n] += dd.init_score.reshape(dd.n, -1).astype(np.float32)
-        v0 = dd._place(v0, extra_dims=2)
+        v0 = self._init_score_tile(dd)
         base = v0 - self._s0
         pred_sum = full - v0
         self._valid_base.append(base)

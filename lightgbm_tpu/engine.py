@@ -47,11 +47,23 @@ def train(params: Dict[str, Any], train_set: Dataset,
         params["objective"] = "custom"
         cfg = Config(params)
 
+    # training continuation (gbdt.cpp: load existing models, rebuild
+    # scores, keep boosting): accept a file path, Booster, or HostModel
+    init_forest = None
     if init_model is not None:
-        log.warning("init_model training continuation is not wired into the "
-                    "engine yet; starting fresh")  # TODO: continuation
+        if isinstance(init_model, Booster):
+            init_forest = (init_model._from_model
+                           if init_model._from_model is not None
+                           else init_model._to_host_model())
+        elif isinstance(init_model, str):
+            from .io.model_text import load_model_string
+            with open(init_model) as f:
+                init_forest = load_model_string(f.read())
+        else:
+            init_forest = init_model
 
-    booster = Booster(params=params, train_set=train_set)
+    booster = Booster(params=params, train_set=train_set,
+                      init_forest=init_forest)
     if valid_sets:
         valid_names = valid_names or [f"valid_{i}"
                                       for i in range(len(valid_sets))]
@@ -81,7 +93,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # identical models, one dispatch per tpu_fuse_iters iterations
     if (not callbacks_before and not callbacks_after and not valid_sets
             and not cfg.is_provide_training_metric and fobj is None
-            and cfg.tpu_fuse_iters > 1
+            and cfg.tpu_fuse_iters > 1 and cfg.snapshot_freq <= 0
             and booster.engine.can_fuse_iters()):
         booster.engine.train_chunk(num_boost_round)
         booster.best_iteration = booster.current_iteration()
@@ -95,6 +107,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         for cb in callbacks_before:
             cb(env_pre)
         booster.update(fobj=fobj)
+        if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
+            # mid-training checkpoint (Application snapshot_freq semantics)
+            booster.save_model(
+                f"{cfg.output_model}.snapshot_iter_{it + 1}")
 
         eval_results = []
         should_eval = ((booster.engine.valid_data or train_as_valid

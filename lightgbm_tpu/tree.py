@@ -134,6 +134,59 @@ class Tree:
 
     # ------------------------------------------------------------------
     @staticmethod
+    def rebin(t: "Tree", bin_mappers, used_features: List[int]) -> "Tree":
+        """Convert a loaded model tree (ORIGINAL feature indices, real
+        thresholds, value-level cat bitsets) into engine form
+        (used-feature indices, bin thresholds, bin-level bitsets) against
+        a dataset's bin mappers — the training-continuation seam
+        (GBDT::ResetTrainingData with existing models, gbdt.cpp). Exact
+        when the dataset/binning match the original training run; bin
+        resolution otherwise."""
+        import dataclasses as _dc
+        from .utils import log as _log
+        pos = {f: i for i, f in enumerate(used_features)}
+        nn = t.num_nodes
+        sf = np.zeros(nn, dtype=np.int32)
+        tb = np.zeros(nn, dtype=np.int32)
+        is_cat = t.is_categorical
+        cat_bs = None
+        if is_cat is not None and np.any(is_cat[:nn]):
+            maxW = max((bin_mappers[int(f)].num_bin + 31) // 32
+                       for f in t.split_feature[:nn])
+            cat_bs = np.zeros((nn, maxW), dtype=np.uint32)
+        for i in range(nn):
+            f = int(t.split_feature[i])
+            if f not in pos:
+                _log.fatal(
+                    f"Cannot continue training: the loaded model splits on "
+                    f"feature {f}, which is unused (trivial) in the new "
+                    f"training data")
+            sf[i] = pos[f]
+            mapper = bin_mappers[f]
+            if is_cat is not None and is_cat[i]:
+                # value-level bitset -> bin-level bitset via cat->bin map
+                ci = int(t.threshold_real[i])
+                words = t.cat_threshold[
+                    t.cat_boundaries[ci]:t.cat_boundaries[ci + 1]]
+                bits = np.unpackbits(
+                    np.ascontiguousarray(words).view(np.uint8),
+                    bitorder="little")
+                for v in np.flatnonzero(bits):
+                    b = mapper.cat_to_bin.get(int(v), -1) \
+                        if mapper.cat_to_bin is not None else -1
+                    if b >= 0:
+                        cat_bs[i, b >> 5] |= np.uint32(1) << np.uint32(
+                            b & 31)
+            else:
+                tb[i] = mapper.value_to_bin(float(t.threshold_real[i]))
+        out = Tree(**{fl.name: getattr(t, fl.name)
+                      for fl in _dc.fields(Tree)})
+        out.split_feature = sf
+        out.threshold_bin = tb
+        out.cat_bitset_bins = cat_bs
+        return out
+
+    @staticmethod
     def from_device(tree_arrays: Dict[str, np.ndarray], shrinkage: float,
                     bin_mappers, used_features: List[int]) -> "Tree":
         """Build from grow_tree's device output (already on host)."""

@@ -1,0 +1,135 @@
+"""Training continuation (init_model), refit, and snapshots
+(reference: gbdt.cpp ResetTrainingData/RefitTree, Application
+snapshot_freq; python-package engine.py init_model semantics)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=4000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    logit = X @ w + 0.5 * X[:, 0] * X[:, 1]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "learning_rate": 0.1}
+
+
+def test_continue_equals_straight_training():
+    """train 10 then continue 10 == train 20 (same data, no sampling)."""
+    X, y = _binary_data()
+    p20 = lgb.train(PARAMS, lgb.Dataset(X, label=y),
+                    num_boost_round=20).predict(X, raw_score=True)
+    bst10 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    cont = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10,
+                     init_model=bst10)
+    assert cont.num_trees() == 20
+    np.testing.assert_allclose(cont.predict(X, raw_score=True), p20,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_continue_from_file(tmp_path):
+    X, y = _binary_data(n=2000)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=5)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    cont = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=5,
+                     init_model=path)
+    assert cont.num_trees() == 10
+    # the continued model's first-5-iteration predictions match the
+    # original (loaded trees adopted verbatim)
+    p5 = bst.predict(X, raw_score=True)
+    p5b = cont.predict(X, raw_score=True, num_iteration=5)
+    np.testing.assert_allclose(p5, p5b, rtol=1e-5, atol=1e-5)
+
+
+def test_continue_multiclass():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float) \
+        + (X[:, 2] > 0.5).astype(float)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbosity": -1}
+    p10 = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=10).predict(X)
+    b5 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    cont = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                     init_model=b5)
+    np.testing.assert_allclose(cont.predict(X), p10, rtol=1e-3, atol=1e-3)
+
+
+def test_refit_decay():
+    X, y = _binary_data(n=2000, seed=3)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    # refit on label-flipped data with decay 0: leaf values re-derived
+    # from the new gradients — predictions must change direction
+    y_flip = 1.0 - y
+    ref0 = bst.refit(X, y_flip, decay_rate=0.0)
+    p_orig = bst.predict(X, raw_score=True)
+    p_ref = ref0.predict(X, raw_score=True)
+    assert np.corrcoef(p_orig, p_ref)[0, 1] < 0
+    # decay 1.0 keeps the old leaf values exactly
+    ref1 = bst.refit(X, y_flip, decay_rate=1.0)
+    np.testing.assert_allclose(ref1.predict(X, raw_score=True), p_orig,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_snapshot_freq(tmp_path):
+    X, y = _binary_data(n=1500, seed=4)
+    out = str(tmp_path / "model.txt")
+    params = dict(PARAMS, snapshot_freq=3, output_model=out)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=7)
+    import os
+    snaps = sorted(p for p in os.listdir(tmp_path)
+                   if ".snapshot_iter_" in p)
+    assert snaps == ["model.txt.snapshot_iter_3", "model.txt.snapshot_iter_6"]
+    snap6 = lgb.Booster(model_file=str(tmp_path / snaps[1]))
+    np.testing.assert_allclose(
+        snap6.predict(X), bst.predict(X, num_iteration=6),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_refit_same_data_decay0_preserves_fit():
+    """Sequential refit (GBDT::RefitTree order) on the training data with
+    decay 0 re-derives ~the same leaf values — NOT zeros (which the
+    broken all-at-final-score formulation would produce)."""
+    X, y = _binary_data(n=2000, seed=5)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    ref = bst.refit(X, y, decay_rate=0.0)
+    p0 = bst.predict(X, raw_score=True)
+    p1 = ref.predict(X, raw_score=True)
+    assert np.corrcoef(p0, p1)[0, 1] > 0.99
+    assert np.std(p1) > 0.5 * np.std(p0)
+
+
+def test_refit_from_model_file_uses_stored_objective(tmp_path):
+    """A Booster loaded from file (empty params) refits with the model's
+    stored objective, not the regression default."""
+    X, y = _binary_data(n=2000, seed=6)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    ref = loaded.refit(X, y, decay_rate=0.0)
+    p0 = bst.predict(X, raw_score=True)
+    p1 = ref.predict(X, raw_score=True)
+    # binary log-loss gradients keep raw scores on the logit scale; the
+    # regression default would collapse them toward [0, 1] residual fits
+    assert np.corrcoef(p0, p1)[0, 1] > 0.99
+    assert p1.max() > 0.7 * p0.max()
+
+
+def test_continuation_mode_mismatch_errors():
+    X, y = _binary_data(n=1000, seed=7)
+    rf = lgb.train({"objective": "binary", "boosting": "rf",
+                    "num_leaves": 7, "bagging_freq": 1,
+                    "bagging_fraction": 0.7, "verbosity": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    import pytest
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=2,
+                  init_model=rf)
