@@ -168,11 +168,14 @@ class GBDT:
         # ---- initial scores (BoostFromAverage, gbdt.cpp) ------------------
         # Under continuation (init_model, gbdt.cpp::ResetTrainingData with
         # existing models) the loaded forest carries the original init
-        # bias in its first trees, so boost-from-average is skipped.
+        # bias in its first trees, so boost-from-average is skipped —
+        # EXCEPT for RF, where every tree independently carries the bias
+        # and gradients are always evaluated at the init score (rf.hpp
+        # computes BoostFromAverage regardless of existing models).
         label_np = self.train_set.metadata.label
         self.init_scores = np.zeros(self.num_class, dtype=np.float64)
         if label_np is not None and self.fobj is None \
-                and init_forest is None:
+                and (init_forest is None or config.boosting == "rf"):
             if self.num_class == 1:
                 self.init_scores[0] = self.objective.init_score(
                     label_np, self.train_set.metadata.weight)

@@ -51,11 +51,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # scores, keep boosting): accept a file path, Booster, or HostModel
     init_forest = None
     if init_model is not None:
+        import os
         if isinstance(init_model, Booster):
             init_forest = (init_model._from_model
                            if init_model._from_model is not None
                            else init_model._to_host_model())
-        elif isinstance(init_model, str):
+        elif isinstance(init_model, (str, os.PathLike)):
             from .io.model_text import load_model_string
             with open(init_model) as f:
                 init_forest = load_model_string(f.read())

@@ -45,6 +45,7 @@ class _RankingBase(Objective):
         self._qidx = None       # [Q, M] padded row indices
         self._qmask = None      # [Q, M] validity
         self._n_rows = 0
+        self._label_gain_table = None   # filled by prepare()
 
     def setup_queries(self, query_boundaries: np.ndarray,
                       n_rows: int) -> None:
@@ -54,7 +55,6 @@ class _RankingBase(Objective):
         self._qidx = jnp.asarray(idx)
         self._qmask = jnp.asarray(idx >= 0)
         self._n_rows = n_rows
-        self._label_gain_table = None
 
     def _gather_queries(self, arr):
         safe = jnp.maximum(self._qidx, 0)

@@ -1,0 +1,192 @@
+"""Plotting utilities: importance / metric / tree visualization.
+
+Reference: python-package/lightgbm/plotting.py (UNVERIFIED — empty mount,
+see SURVEY.md banner): matplotlib horizontal-bar importances, recorded
+eval-metric curves, and graphviz tree diagrams. matplotlib/graphviz are
+imported lazily so the core package stays import-light.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import numpy as np
+
+from .basic import Booster
+from .utils.log import LightGBMError
+
+__all__ = ["plot_importance", "plot_metric", "plot_tree",
+           "create_tree_digraph"]
+
+
+def _check_matplotlib():
+    try:
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "You must install matplotlib to plot importance/metric") from e
+
+
+def _to_booster(booster) -> Booster:
+    if isinstance(booster, Booster):
+        return booster
+    if hasattr(booster, "booster_"):     # sklearn estimator
+        return booster.booster_
+    raise TypeError("booster must be a Booster or LGBMModel instance")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim=None, ylim=None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "auto",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: int = 3, **kwargs):
+    """Horizontal-bar feature importances (lightgbm.plot_importance)."""
+    plt = _check_matplotlib()
+    bst = _to_booster(booster)
+    if importance_type == "auto":
+        importance_type = getattr(booster, "importance_type", "split")
+    imp = np.asarray(bst.feature_importance(importance_type))
+    names = bst.feature_name()
+    pairs = sorted(zip(imp, names), key=lambda t: t[0])
+    if ignore_zero:
+        pairs = [p for p in pairs if p[0] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        pairs = pairs[-max_num_features:]
+    if not pairs:
+        raise ValueError(
+            "Cannot plot trees with zero feature importance")
+    values, labels = zip(*pairs)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ypos = np.arange(len(values))
+    ax.barh(ypos, values, height=height, align="center", **kwargs)
+    for y, v in zip(ypos, values):
+        ax.text(v + 1e-12, y,
+                f"{v:.{precision}f}" if importance_type == "gain"
+                else str(int(v)), va="center")
+    ax.set_yticks(ypos)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster: Union[Dict, Any], metric: Optional[str] = None,
+                dataset_names=None, ax=None, xlim=None, ylim=None,
+                title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "@metric@",
+                figsize=None, dpi=None, grid: bool = True):
+    """Plot recorded eval results (lightgbm.plot_metric): accepts the
+    dict filled by ``record_evaluation`` or a fitted sklearn estimator."""
+    plt = _check_matplotlib()
+    if isinstance(booster, dict):
+        eval_results = booster
+    elif hasattr(booster, "evals_result_"):
+        eval_results = booster.evals_result_
+    else:
+        raise TypeError(
+            "booster must be a dict from record_evaluation or a fitted "
+            "LGBMModel (train() Boosters don't store eval history)")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = dataset_names or list(eval_results.keys())
+    for name in names:
+        metrics = eval_results[name]
+        m = metric or next(iter(metrics))
+        ax.plot(metrics[m], label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(metric or next(iter(eval_results[names[0]]))
+                  if ylabel == "@metric@" else ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _tree_to_graph(model, tree_index: int, precision: int = 3,
+                   **kwargs):
+    import graphviz
+    t = model.trees[tree_index]
+    g = graphviz.Digraph(**kwargs)
+    names = model.feature_names
+
+    def leaf_label(i):
+        return (f"leaf {i}: {t.leaf_value[i]:.{precision}f}\n"
+                f"count: {int(t.leaf_count[i])}")
+
+    for nd in range(t.num_nodes):
+        f = int(t.split_feature[nd])
+        fname = names[f] if f < len(names) else f"Column_{f}"
+        if t.is_categorical is not None and t.is_categorical[nd]:
+            lab = f"{fname} in {{...}}"
+        else:
+            lab = f"{fname} <= {t.threshold_real[nd]:.{precision}g}"
+        g.node(f"split{nd}", label=f"{lab}\ngain: "
+                                   f"{t.split_gain[nd]:.{precision}g}")
+    for nd in range(t.num_nodes):
+        for child, tag in ((t.left_child[nd], "yes"),
+                           (t.right_child[nd], "no")):
+            if child >= 0:
+                g.edge(f"split{nd}", f"split{child}", label=tag)
+            else:
+                leaf = -int(child) - 1
+                g.node(f"leaf{leaf}", label=leaf_label(leaf),
+                       shape="box")
+                g.edge(f"split{nd}", f"leaf{leaf}", label=tag)
+    if t.num_nodes == 0:
+        g.node("leaf0", label=leaf_label(0), shape="box")
+    return g
+
+
+def create_tree_digraph(booster, tree_index: int = 0,
+                        precision: int = 3, **kwargs):
+    """graphviz.Digraph of one tree (lightgbm.create_tree_digraph)."""
+    bst = _to_booster(booster)
+    try:
+        import graphviz  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "You must install graphviz to plot tree") from e
+    model = (bst._from_model if bst._from_model is not None
+             else bst._to_host_model())
+    if not 0 <= tree_index < len(model.trees):
+        raise IndexError(f"tree_index {tree_index} out of range "
+                         f"(0..{len(model.trees) - 1})")
+    return _tree_to_graph(model, tree_index, precision=precision)
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None,
+              dpi=None, precision: int = 3, **kwargs):
+    """Render one tree into a matplotlib axis (lightgbm.plot_tree)."""
+    plt = _check_matplotlib()
+    graph = create_tree_digraph(booster, tree_index=tree_index,
+                                precision=precision, **kwargs)
+    from io import BytesIO
+    try:
+        import matplotlib.image as mpimg
+        s = BytesIO(graph.pipe(format="png"))
+        img = mpimg.imread(s)
+    except Exception as e:
+        raise LightGBMError(
+            f"Rendering the tree requires the graphviz binary: {e}") from e
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -1,0 +1,283 @@
+"""scikit-learn estimator API: LGBMModel / Classifier / Regressor / Ranker.
+
+Reference: python-package/lightgbm/sklearn.py (UNVERIFIED — empty mount,
+see SURVEY.md banner): thin estimator shells over ``train()`` — sklearn
+constructor params map onto LightGBM params through the config alias
+table (n_estimators→num_iterations, subsample→bagging_fraction,
+reg_alpha→lambda_l1, ...), fit() builds Datasets and delegates, the
+classifier label-encodes and exposes predict_proba, the ranker wires
+query groups.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+try:  # inherit real sklearn base classes when available (tags, clone)
+    from sklearn.base import BaseEstimator as _SKBase
+    from sklearn.base import ClassifierMixin as _SKClassifier
+    from sklearn.base import RegressorMixin as _SKRegressor
+    _SKLEARN = True
+except ImportError:  # pragma: no cover - sklearn is in the image
+    _SKBase = object
+
+    class _SKClassifier:
+        pass
+
+    class _SKRegressor:
+        pass
+    _SKLEARN = False
+
+from .basic import Booster, Dataset, LightGBMError
+from .engine import train
+
+__all__ = ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+
+
+class LGBMModel(_SKBase):
+    """Base sklearn-style estimator (lightgbm.LGBMModel surface)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[Union[str, Callable]] = None,
+                 class_weight=None, min_split_gain: float = 0.0,
+                 min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state: Optional[int] = None,
+                 n_jobs: Optional[int] = None,
+                 importance_type: str = "split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._other_params: Dict[str, Any] = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._Booster: Optional[Booster] = None
+        self.best_iteration_ = -1
+        self.best_score_: Dict = {}
+        self.evals_result_: Dict = {}
+
+    # -- sklearn plumbing ------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = (super().get_params(deep=deep) if _SKLEARN
+                  else {k: getattr(self, k) for k in (
+                      "boosting_type", "num_leaves", "max_depth",
+                      "learning_rate", "n_estimators", "subsample_for_bin",
+                      "objective", "class_weight", "min_split_gain",
+                      "min_child_weight", "min_child_samples", "subsample",
+                      "subsample_freq", "colsample_bytree", "reg_alpha",
+                      "reg_lambda", "random_state", "n_jobs",
+                      "importance_type")})
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for k, v in params.items():
+            setattr(self, k, v)
+            if k not in self.__init__.__code__.co_varnames:
+                self._other_params[k] = v
+        return self
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _make_params(self) -> Dict[str, Any]:
+        p = self.get_params()
+        p.pop("n_jobs", None)           # XLA owns threading
+        p.pop("class_weight", None)
+        p.pop("importance_type", None)
+        p["boosting"] = p.pop("boosting_type", "gbdt")
+        p["num_iterations"] = p.pop("n_estimators", 100)
+        if p.get("random_state") is None:
+            p.pop("random_state", None)
+        obj = p.get("objective")
+        if obj is None:
+            p["objective"] = self._default_objective()
+        p.setdefault("verbosity", -1)
+        return p
+
+    # -- training --------------------------------------------------------
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None, init_model=None) -> "LGBMModel":
+        params = self._make_params()
+        if eval_metric is not None:
+            params["metric"] = eval_metric
+        y2, sample_weight = self._process_label(y, sample_weight)
+        ds = Dataset(X, label=y2, weight=sample_weight,
+                     init_score=init_score, group=group,
+                     feature_name=feature_name,
+                     categorical_feature=categorical_feature)
+        valid_sets, valid_names = [], []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vy2, vw = self._process_label(
+                    vy, eval_sample_weight[i] if eval_sample_weight
+                    else None)
+                vgroup = eval_group[i] if eval_group else None
+                vinit = eval_init_score[i] if eval_init_score else None
+                if np.shape(vx) == np.shape(X) \
+                        and np.allclose(np.asarray(vx, dtype=np.float64),
+                                        Dataset._to_matrix(X),
+                                        equal_nan=True):
+                    valid_sets.append(ds)
+                else:
+                    valid_sets.append(ds.create_valid(
+                        vx, label=vy2, weight=vw, group=vgroup,
+                        init_score=vinit))
+                valid_names.append(eval_names[i] if eval_names
+                                   else f"valid_{i}")
+        self.evals_result_ = {}
+        callbacks = list(callbacks or [])
+        from .callback import record_evaluation
+        callbacks.append(record_evaluation(self.evals_result_))
+        fobj = self.objective if callable(self.objective) else None
+        if fobj is not None:
+            params["objective"] = "custom"
+        self._Booster = train(
+            params, ds, valid_sets=valid_sets or None,
+            valid_names=valid_names or None, callbacks=callbacks,
+            init_model=init_model, fobj=fobj)
+        self.best_iteration_ = self._Booster.best_iteration
+        self.best_score_ = self._Booster.best_score
+        self.n_features_ = self._Booster.num_feature()
+        self.n_features_in_ = self.n_features_
+        self.feature_name_ = self._Booster.feature_name()
+        self.fitted_ = True
+        return self
+
+    def _process_label(self, y, sample_weight):
+        return np.asarray(y, dtype=np.float64).ravel(), sample_weight
+
+    # -- inference -------------------------------------------------------
+    def predict(self, X, raw_score: bool = False,
+                start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        return self.booster_.predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib, **kwargs)
+
+    # -- fitted attributes ----------------------------------------------
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise LightGBMError(
+                "No booster found. Need to call fit beforehand.")
+        return self._Booster
+
+    @property
+    def n_estimators_(self) -> int:
+        return self.booster_.current_iteration()
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        return self.booster_.feature_importance(
+            importance_type=self.importance_type)
+
+    @property
+    def objective_(self):
+        return (self.objective if self.objective is not None
+                else self._default_objective())
+
+    def __sklearn_is_fitted__(self) -> bool:
+        return getattr(self, "fitted_", False)
+
+
+class LGBMRegressor(_SKRegressor, LGBMModel):
+    """lightgbm.LGBMRegressor"""
+
+
+class LGBMClassifier(_SKClassifier, LGBMModel):
+    """lightgbm.LGBMClassifier: label-encodes arbitrary class labels,
+    auto-selects binary vs multiclass, exposes predict_proba."""
+
+    def _default_objective(self) -> str:
+        return ("multiclass" if getattr(self, "n_classes_", 2) > 2
+                else "binary")
+
+    def _process_label(self, y, sample_weight):
+        y = np.asarray(y).ravel()
+        enc = np.searchsorted(self.classes_, y)
+        ok = (enc < len(self.classes_))
+        enc = np.clip(enc, 0, len(self.classes_) - 1)
+        if not np.all(ok & (self.classes_[enc] == y)):
+            raise LightGBMError("eval_set labels contain classes unseen "
+                                "in y")
+        if self.class_weight is not None and sample_weight is None:
+            if self.class_weight == "balanced":
+                cnt = np.bincount(enc, minlength=self.n_classes_)
+                w_per_class = len(y) / (self.n_classes_
+                                        * np.maximum(cnt, 1))
+            else:
+                w_per_class = np.array(
+                    [self.class_weight.get(c, 1.0)
+                     for c in self.classes_], dtype=np.float64)
+            sample_weight = w_per_class[enc]
+        return enc.astype(np.float64), sample_weight
+
+    def fit(self, X, y, **kwargs):
+        y_arr = np.asarray(y).ravel()
+        self.classes_ = np.unique(y_arr)
+        self.n_classes_ = len(self.classes_)
+        if self.n_classes_ > 2 and not callable(self.objective):
+            self._other_params.setdefault("num_class", self.n_classes_)
+            setattr(self, "num_class", self.n_classes_)
+        return super().fit(X, y, **kwargs)
+
+    def predict_proba(self, X, raw_score: bool = False,
+                      start_iteration: int = 0,
+                      num_iteration: Optional[int] = None,
+                      **kwargs) -> np.ndarray:
+        p = self.booster_.predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, **kwargs)
+        if raw_score or p.ndim == 2:
+            return p
+        return np.column_stack([1.0 - p, p])
+
+    def predict(self, X, raw_score: bool = False, **kwargs) -> np.ndarray:
+        p = self.predict_proba(X, raw_score=raw_score, **kwargs)
+        if raw_score or kwargs.get("pred_leaf") \
+                or kwargs.get("pred_contrib"):
+            return p
+        return self.classes_[np.argmax(p, axis=1)]
+
+
+class LGBMRanker(LGBMModel):
+    """lightgbm.LGBMRanker: lambdarank with query groups."""
+
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            raise LightGBMError("Should set group for ranking task")
+        return super().fit(X, y, group=group, **kwargs)

@@ -149,11 +149,8 @@ class Tree:
         sf = np.zeros(nn, dtype=np.int32)
         tb = np.zeros(nn, dtype=np.int32)
         is_cat = t.is_categorical
-        cat_bs = None
-        if is_cat is not None and np.any(is_cat[:nn]):
-            maxW = max((bin_mappers[int(f)].num_bin + 31) // 32
-                       for f in t.split_feature[:nn])
-            cat_bs = np.zeros((nn, maxW), dtype=np.uint32)
+        # validate every split feature BEFORE any mapper access so the
+        # user sees the clean fatal, not an IndexError
         for i in range(nn):
             f = int(t.split_feature[i])
             if f not in pos:
@@ -161,6 +158,23 @@ class Tree:
                     f"Cannot continue training: the loaded model splits on "
                     f"feature {f}, which is unused (trivial) in the new "
                     f"training data")
+            node_cat = bool(is_cat[i]) if is_cat is not None else False
+            mapper_cat = bin_mappers[f].bin_type == "categorical"
+            if node_cat != mapper_cat:
+                _log.fatal(
+                    f"Cannot continue training: the loaded model treats "
+                    f"feature {f} as "
+                    f"{'categorical' if node_cat else 'numerical'} but the "
+                    f"new dataset binned it as "
+                    f"{'categorical' if mapper_cat else 'numerical'} — "
+                    f"pass the same categorical_feature list")
+        cat_bs = None
+        if is_cat is not None and np.any(is_cat[:nn]):
+            maxW = max((bin_mappers[int(f)].num_bin + 31) // 32
+                       for f in t.split_feature[:nn])
+            cat_bs = np.zeros((nn, maxW), dtype=np.uint32)
+        for i in range(nn):
+            f = int(t.split_feature[i])
             sf[i] = pos[f]
             mapper = bin_mappers[f]
             if is_cat is not None and is_cat[i]:

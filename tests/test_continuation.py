@@ -133,3 +133,27 @@ def test_continuation_mode_mismatch_errors():
     with pytest.raises(lgb.LightGBMError):
         lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=2,
                   init_model=rf)
+
+
+def test_rf_continuation_keeps_bias():
+    """RF-to-RF continuation: new trees must carry the init bias like the
+    loaded ones (rf.hpp computes BoostFromAverage regardless of existing
+    models), so continued-10 == straight-10 on imbalanced data."""
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] + rng.normal(scale=0.5, size=2000) > 1.0).astype(float)
+    rf_params = {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+                 "bagging_freq": 1, "bagging_fraction": 0.7,
+                 "verbosity": -1}
+    straight = lgb.train(rf_params, lgb.Dataset(X, label=y),
+                         num_boost_round=10)
+    b5 = lgb.train(rf_params, lgb.Dataset(X, label=y), num_boost_round=5)
+    cont = lgb.train(rf_params, lgb.Dataset(X, label=y), num_boost_round=5,
+                     init_model=b5)
+    assert cont.num_trees() == 10
+    p_straight = straight.predict(X, raw_score=True)
+    p_cont = cont.predict(X, raw_score=True)
+    # same bagging RNG stream restarts, so trees differ — but the biased
+    # averages must sit on the same scale (a dropped bias would shift
+    # the mean by the init logit, ~-1.9 here)
+    assert abs(p_cont.mean() - p_straight.mean()) < 0.15

@@ -209,3 +209,26 @@ def test_nan_handling():
                "verbosity": -1}, ds, num_boost_round=20, valid_sets=[vs],
               callbacks=[lgb.record_evaluation(res)])
     assert res["valid_0"]["auc"][-1] > 0.85
+
+
+def test_lambdarank_end_to_end():
+    """Pins the engine-level lambdarank path (a setup_queries/prepare
+    ordering bug once silently cleared the label-gain table)."""
+    rng = np.random.default_rng(11)
+    n_q, per_q = 50, 20
+    X = rng.normal(size=(n_q * per_q, 6))
+    y = np.minimum(np.clip(X[:, 0] * 1.5
+                           + rng.normal(scale=0.4, size=len(X)),
+                           0, None).astype(int), 4)
+    group = np.full(n_q, per_q)
+    n_tr = 40 * per_q
+    ds = lgb.Dataset(X[:n_tr], label=y[:n_tr], group=group[:40])
+    vs = ds.create_valid(X[n_tr:], label=y[n_tr:], group=group[40:])
+    res = {}
+    lgb.train({"objective": "lambdarank", "num_leaves": 15,
+               "metric": "ndcg", "ndcg_eval_at": [5], "verbosity": -1},
+              ds, num_boost_round=30, valid_sets=[vs],
+              callbacks=[lgb.record_evaluation(res)])
+    ndcg = res["valid_0"]["ndcg@5"]
+    assert ndcg[-1] > 0.7
+    assert ndcg[-1] > ndcg[0]

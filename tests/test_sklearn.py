@@ -1,0 +1,132 @@
+"""sklearn estimator API tests (mirrors reference test_sklearn.py style)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+
+def _binary_data(n=3000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = ((X @ w + rng.normal(scale=0.5, size=n)) > 0).astype(int)
+    return X, y
+
+
+def test_classifier_fit_predict():
+    X, y = _binary_data()
+    clf = LGBMClassifier(n_estimators=20, num_leaves=15)
+    clf.fit(X[:2400], y[:2400])
+    pred = clf.predict(X[2400:])
+    proba = clf.predict_proba(X[2400:])
+    assert set(np.unique(pred)) <= {0, 1}
+    assert proba.shape == (600, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    acc = np.mean(pred == y[2400:])
+    assert acc > 0.85
+    assert clf.n_features_ == 10
+    assert len(clf.feature_importances_) == 10
+
+
+def test_classifier_string_labels():
+    X, y = _binary_data(n=1500)
+    labels = np.array(["neg", "pos"])[y]
+    clf = LGBMClassifier(n_estimators=10, num_leaves=7)
+    clf.fit(X, labels)
+    assert list(clf.classes_) == ["neg", "pos"]
+    pred = clf.predict(X)
+    assert set(np.unique(pred)) <= {"neg", "pos"}
+    assert np.mean(pred == labels) > 0.85
+
+
+def test_classifier_multiclass_auto():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    clf = LGBMClassifier(n_estimators=10, num_leaves=7)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (2000, 3)
+    assert np.mean(clf.predict(X) == y) > 0.8
+
+
+def test_regressor_fit_predict_eval_set():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(3000, 8))
+    w = rng.normal(size=8)
+    y = X @ w + rng.normal(scale=0.1, size=3000)
+    reg = LGBMRegressor(n_estimators=30, num_leaves=31)
+    reg.fit(X[:2400], y[:2400], eval_set=[(X[2400:], y[2400:])],
+            eval_metric="l2")
+    assert "valid_0" in reg.evals_result_
+    l2 = reg.evals_result_["valid_0"]["l2"]
+    assert l2[-1] < l2[0] * 0.3
+    pred = reg.predict(X[2400:])
+    assert np.mean((pred - y[2400:]) ** 2) < np.var(y) * 0.2
+
+
+def test_regressor_sklearn_params_map():
+    """subsample/reg_alpha/etc resolve through the alias table."""
+    X, y = _binary_data(n=1000)
+    reg = LGBMRegressor(n_estimators=5, num_leaves=7, subsample=0.8,
+                        subsample_freq=1, colsample_bytree=0.7,
+                        reg_alpha=0.1, reg_lambda=0.2, random_state=7)
+    reg.fit(X, y.astype(float))
+    cfg = reg.booster_.config
+    assert cfg.bagging_fraction == 0.8
+    assert cfg.feature_fraction == 0.7
+    assert cfg.lambda_l1 == 0.1 and cfg.lambda_l2 == 0.2
+
+
+def test_ranker_group():
+    rng = np.random.default_rng(3)
+    n_q, per_q = 40, 25
+    X = rng.normal(size=(n_q * per_q, 6))
+    rel = np.clip((X[:, 0] * 1.5 + rng.normal(scale=0.4,
+                                              size=len(X))), 0, None)
+    y = np.minimum(rel.astype(int), 4)
+    group = np.full(n_q, per_q)
+    n_tr = 30 * per_q
+    rk = LGBMRanker(n_estimators=15, num_leaves=15)
+    rk.fit(X[:n_tr], y[:n_tr], group=group[:30],
+           eval_set=[(X[n_tr:], y[n_tr:])], eval_group=[group[30:]],
+           eval_metric="ndcg")
+    assert any(k.startswith("ndcg") for k in rk.evals_result_["valid_0"])
+    with pytest.raises(lgb.LightGBMError):
+        LGBMRanker().fit(X, y)   # no group
+
+
+def test_class_weight_balanced():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(2000, 6))
+    y = (X[:, 0] + rng.normal(scale=0.3, size=2000) > 1.2).astype(int)
+    clf = LGBMClassifier(n_estimators=10, num_leaves=7,
+                         class_weight="balanced")
+    clf.fit(X, y)
+    # balanced weighting pushes the minority-class probabilities up
+    clf2 = LGBMClassifier(n_estimators=10, num_leaves=7)
+    clf2.fit(X, y)
+    assert clf.predict_proba(X)[:, 1].mean() \
+        > clf2.predict_proba(X)[:, 1].mean()
+
+
+def test_sklearn_clone_and_get_params():
+    from sklearn.base import clone
+    clf = LGBMClassifier(n_estimators=7, num_leaves=9, min_child_samples=5)
+    c2 = clone(clf)
+    assert c2.get_params()["n_estimators"] == 7
+    assert c2.get_params()["num_leaves"] == 9
+
+
+def test_plotting_smoke(tmp_path):
+    import matplotlib
+    matplotlib.use("Agg")
+    X, y = _binary_data(n=1000)
+    clf = LGBMClassifier(n_estimators=5, num_leaves=7)
+    clf.fit(X, y, eval_set=[(X, y)], eval_metric="auc")
+    ax = lgb.plot_importance(clf)
+    assert ax is not None
+    ax2 = lgb.plot_metric(clf.evals_result_, metric="auc")
+    assert ax2 is not None
