@@ -47,34 +47,57 @@ class _DeviceData:
     """
 
     def __init__(self, ds: Dataset, rows_per_block: int, mesh=None,
-                 transposed: bool = False):
+                 transposed: bool = False, shard_features: bool = False,
+                 n_feature_pad: int = 0):
         ds.construct()
         self.n = ds.num_data
-        n_shards = mesh.devices.size if mesh is not None else 1
-        self.n_pad = pad_rows(self.n, rows_per_block * n_shards)
+        # feature-parallel replicates rows; data/voting shard them
+        row_shards = (mesh.devices.size
+                      if mesh is not None and not shard_features else 1)
+        self.n_pad = pad_rows(self.n, rows_per_block * row_shards)
         binned = ds.binned
+        if n_feature_pad and binned.shape[1] < n_feature_pad:
+            # pad feature columns so every device owns an equal slice
+            # (scatter/feature-parallel); padded features never split
+            # (num_bin=1, allowed=False in the engine's metadata)
+            binned = np.concatenate(
+                [binned, np.zeros((binned.shape[0],
+                                   n_feature_pad - binned.shape[1]),
+                                  binned.dtype)], axis=1)
         if self.n_pad > self.n:
             pad = np.zeros((self.n_pad - self.n, binned.shape[1]),
                            dtype=binned.dtype)
             binned = np.concatenate([binned, pad], axis=0)
 
         from ..parallel.mesh import NamedSharding, P, shard_rows
+        axis = mesh.axis_names[0] if mesh is not None else None
 
         def place(a, extra_dims=1):
             if mesh is None:
                 return jnp.asarray(a)
+            if shard_features:
+                # rows replicated under feature-parallel
+                return jax.device_put(np.asarray(a),
+                                      NamedSharding(mesh, P()))
             return shard_rows(mesh, np.asarray(a), extra_dims)
 
-        self.bins = place(binned, extra_dims=2)
+        if mesh is not None and shard_features:
+            self.bins = jax.device_put(
+                binned, NamedSharding(mesh, P(None, axis)))
+        else:
+            self.bins = place(binned, extra_dims=2)
         self.bins_t = None
         if transposed:
             # feature-major int8 copy for the Pallas histogram kernel
             bt = np.ascontiguousarray(binned.T).astype(np.int8)
             if mesh is None:
                 self.bins_t = jnp.asarray(bt)
+            elif shard_features:
+                self.bins_t = jax.device_put(
+                    bt, NamedSharding(mesh, P(axis, None)))
             else:
                 self.bins_t = jax.device_put(
-                    bt, NamedSharding(mesh, P(None, "data")))
+                    bt, NamedSharding(mesh, P(None, axis)))
         self._place = place
         md = ds.metadata
 
@@ -107,14 +130,22 @@ class GBDT:
         self.fobj = fobj
         # distributed learner selection (TreeLearner factory seam,
         # src/treelearner/tree_learner.cpp): serial runs single-device;
-        # data/voting/feature shard rows over a mesh
+        # data/voting shard rows, feature shards columns over a mesh
         self.mesh = mesh
         if (self.mesh is None and config.tree_learner != "serial"
                 and jax.device_count() > 1):
-            from ..parallel.mesh import create_data_mesh
-            self.mesh = create_data_mesh()
+            from ..parallel.mesh import (create_data_mesh,
+                                         create_feature_mesh)
+            self.mesh = (create_feature_mesh()
+                         if config.tree_learner == "feature"
+                         else create_data_mesh())
         if self.mesh is not None and config.tree_learner == "serial":
             self.mesh = None
+        self.learner_type = config.tree_learner if self.mesh is not None \
+            else "serial"
+        self._shard_features = self.learner_type == "feature"
+        self.axis = (self.mesh.axis_names[0]
+                     if self.mesh is not None else "")
         self.objective: Objective = create_objective(config)
         if hasattr(self.objective, "prepare") and \
                 self.train_set.metadata.label is not None:
@@ -138,6 +169,15 @@ class GBDT:
 
         F = len(self.train_set.used_features)
         self.num_features = F
+        # pad feature count to a multiple of the shard count so scatter /
+        # feature-parallel slices are equal-width (padded features carry
+        # num_bin=1 + allowed=False, so they never win a split)
+        need_fpad = self.mesh is not None and (
+            self._shard_features
+            or (self.learner_type == "data"
+                and config.tpu_hist_reduce == "scatter"))
+        self.F_pad = (_ceil_to(max(F, 1), n_shards) if need_fpad else F)
+        fpad = self.F_pad - F
         num_bin = self.train_set.feature_num_bins()
         self.max_num_bin = int(num_bin.max()) if F else 2
         # static histogram width: pad to a lane-friendly multiple
@@ -150,6 +190,10 @@ class GBDT:
         has_nan = np.array(
             [self.train_set.bin_mappers[f].missing_type == "nan"
              for f in self.train_set.used_features], dtype=bool) & ~is_cat
+        if fpad:
+            num_bin = np.concatenate([num_bin, np.ones(fpad, num_bin.dtype)])
+            has_nan = np.concatenate([has_nan, np.zeros(fpad, bool)])
+            is_cat = np.concatenate([is_cat, np.zeros(fpad, bool)])
         self.feat_num_bin = jnp.asarray(num_bin.astype(np.int32))
         self.feat_has_nan = jnp.asarray(has_nan)
         self.has_categorical = bool(is_cat.any())
@@ -161,7 +205,9 @@ class GBDT:
                                and self.B <= 256
                                and jax.default_backend() == "tpu")
         self.data = _DeviceData(self.train_set, rows_per_block, self.mesh,
-                                transposed=self.use_pallas)
+                                transposed=self.use_pallas,
+                                shard_features=self._shard_features,
+                                n_feature_pad=self.F_pad)
 
         self.grow_cfg = self._make_grow_cfg()
 
@@ -234,7 +280,10 @@ class GBDT:
             self.score = self.score + raw
 
     def add_valid(self, ds: Dataset, name: str) -> None:
-        dd = _DeviceData(ds.construct(), self.rows_per_block, self.mesh)
+        # feature-parallel keeps valid sets unsharded (prediction needs
+        # every column); data/voting shard valid rows like train rows
+        dd = _DeviceData(ds.construct(), self.rows_per_block,
+                         None if self._shard_features else self.mesh)
         score0 = self._init_score_tile(dd)
         if self.models:
             stacked, class_idx = self._stack_models(0, len(self.models))
@@ -268,13 +317,21 @@ class GBDT:
             precise_histogram=config.tpu_double_precision_hist,
             leaf_batch=max(1, config.tpu_leaf_batch),
             use_pallas=self.use_pallas,
-            axis_name=("data" if self.mesh is not None else ""),
+            axis_name=(self.axis if self.mesh is not None
+                       and not self._shard_features else ""),
             has_categorical=self.has_categorical,
             max_cat_threshold=config.max_cat_threshold,
             cat_smooth=config.cat_smooth,
             cat_l2=config.cat_l2,
             max_cat_to_onehot=config.max_cat_to_onehot,
             min_data_per_group=config.min_data_per_group,
+            hist_scatter=(self.learner_type == "data"
+                          and config.tpu_hist_reduce == "scatter"),
+            num_shards=(self.mesh.devices.size
+                        if self.mesh is not None else 1),
+            voting=self.learner_type == "voting",
+            top_k=config.top_k,
+            feature_axis=(self.axis if self._shard_features else ""),
         )
 
     # ------------------------------------------------------------------
@@ -416,17 +473,30 @@ class GBDT:
                          for i, s in enumerate(valid_scores)]
                 return valid_update_impl(pairs, stacked_trees)
         else:
-            # SPMD data-parallel: rows sharded over the "data" mesh axis;
-            # histograms psum inside grow_tree (GrowConfig.axis_name); tree
-            # decisions are computed redundantly on every device from the
-            # reduced histograms, so the tree outputs are replicated —
-            # mirroring the reference data_parallel learner's global sync
-            # (SURVEY.md §3.4) without any per-split host round-trip.
+            # SPMD distributed: data/voting shard rows over the mesh axis
+            # (histograms psum / psum_scatter / vote-reduce inside
+            # grow_tree per GrowConfig); feature-parallel shards COLUMNS,
+            # replicating rows, with the split search sliced per device
+            # and the winner elected by all_gather. Tree decisions end up
+            # replicated either way — mirroring the reference parallel
+            # learners' global sync (SURVEY.md §3.4) without any
+            # per-split host round-trip.
             from ..parallel.mesh import P, shard_map
             d = self.data
-            row2 = P("data", None)
-            row1 = P("data")
+            ax = self.axis
             rep = P()
+            if self._shard_features:
+                row2 = rep          # rows replicated
+                row1 = rep
+                bins_spec = P(None, ax)     # [n, F] columns sharded
+                bt_spec = P(ax, None)       # [F, n]
+                leaf_id_spec = rep
+            else:
+                row2 = P(ax, None)
+                row1 = P(ax)
+                bins_spec = row2
+                bt_spec = P(None, ax)       # [F, n] sharded over rows
+                leaf_id_spec = P(None, ax)
             tree_keys = ["num_leaves", "split_feature", "threshold_bin",
                          "default_left", "left_child", "right_child",
                          "split_gain", "internal_value", "internal_count",
@@ -434,25 +504,24 @@ class GBDT:
             if self.has_categorical:
                 tree_keys += ["is_cat", "cat_bitset"]
             tree_specs = {k: rep for k in tree_keys}
-            out_specs = (tree_specs, P(None, "data"), row2)
+            out_specs = (tree_specs, leaf_id_spec, row2)
 
             w_spec = rep if d.weight is None else row1
-            bt_spec = P(None, "data")  # [F, n] sharded over rows
             sharded_step = shard_map(
                 step_impl, mesh=mesh,
-                in_specs=(row2, bt_spec, row1, w_spec, row2, row1, row1,
-                          rep, rep),
+                in_specs=(bins_spec, bt_spec, row1, w_spec, row2, row1,
+                          row1, rep, rep),
                 out_specs=out_specs, check_vma=False)
             sharded_goss = shard_map(
                 step_goss_impl, mesh=mesh,
-                in_specs=(row2, bt_spec, row1, w_spec, row2, row1, rep,
-                          rep),
+                in_specs=(bins_spec, bt_spec, row1, w_spec, row2, row1,
+                          rep, rep),
                 out_specs=out_specs, check_vma=False)
             grad_spec = row2 if K > 1 else row1
             sharded_custom = shard_map(
                 step_custom_impl, mesh=mesh,
-                in_specs=(row2, bt_spec, row2, grad_spec, grad_spec, row1,
-                          row1, rep),
+                in_specs=(bins_spec, bt_spec, row2, grad_spec, grad_spec,
+                          row1, row1, rep),
                 out_specs=out_specs, check_vma=False)
 
             @jax.jit
@@ -471,20 +540,30 @@ class GBDT:
                 return sharded_custom(d.bins, d.bins_t, score, g, h,
                                       mask_gh, mask_count, allowed)
 
-            @jax.jit
-            def valid_update(valid_scores, stacked_trees):
-                n_valid = len(valid_scores)
-                fn = shard_map(
-                    lambda bins_scores, trees: tuple(valid_update_impl(
-                        list(bins_scores), trees)),
-                    mesh=mesh,
-                    in_specs=(tuple((row2, row2) for _ in range(n_valid)),
-                              tree_specs),
-                    out_specs=tuple(row2 for _ in range(n_valid)),
-                    check_vma=False)
-                pairs = tuple((self.valid_data[i].bins, s)
-                              for i, s in enumerate(valid_scores))
-                return list(fn(pairs, stacked_trees))
+            if self._shard_features:
+                # feature-parallel valid sets are replicated (prediction
+                # needs all columns); plain jit, no shard_map
+                @jax.jit
+                def valid_update(valid_scores, stacked_trees):
+                    pairs = [(self.valid_data[i].bins, s)
+                             for i, s in enumerate(valid_scores)]
+                    return valid_update_impl(pairs, stacked_trees)
+            else:
+                @jax.jit
+                def valid_update(valid_scores, stacked_trees):
+                    n_valid = len(valid_scores)
+                    fn = shard_map(
+                        lambda bins_scores, trees: tuple(valid_update_impl(
+                            list(bins_scores), trees)),
+                        mesh=mesh,
+                        in_specs=(tuple((row2, row2)
+                                        for _ in range(n_valid)),
+                                  tree_specs),
+                        out_specs=tuple(row2 for _ in range(n_valid)),
+                        check_vma=False)
+                    pairs = tuple((self.valid_data[i].bins, s)
+                                  for i, s in enumerate(valid_scores))
+                    return list(fn(pairs, stacked_trees))
 
         @jax.jit
         def apply_renewed(score, leaf_ids, renewed_leaf_values):
@@ -502,7 +581,7 @@ class GBDT:
         F = self.num_features
 
         def make_chunk(goss: bool):
-            allowed_all = jnp.ones(F, dtype=bool)
+            allowed_all = jnp.asarray(np.arange(self.F_pad) < F)
             d_ = self.data
 
             def chunk_impl(bins, bins_t, label, weight, score, valid_mask,
@@ -529,7 +608,8 @@ class GBDT:
 
             sharded_chunk = shard_map(
                 chunk_impl, mesh=mesh,
-                in_specs=(row2, bt_spec, row1, w_spec, row2, row1, rep),
+                in_specs=(bins_spec, bt_spec, row1, w_spec, row2, row1,
+                          rep),
                 out_specs=(row2, tree_specs), check_vma=False)
 
             @jax.jit
@@ -550,12 +630,13 @@ class GBDT:
     def _feature_mask(self) -> jnp.ndarray:
         F = self.num_features
         frac = self.config.feature_fraction
+        mask = np.zeros(self.F_pad, dtype=bool)
         if frac >= 1.0 or F == 0:
-            return jnp.ones(F, dtype=bool)
-        k = max(1, int(np.ceil(F * frac)))
-        chosen = self._rng_feature.choice(F, size=k, replace=False)
-        mask = np.zeros(F, dtype=bool)
-        mask[chosen] = True
+            mask[:F] = True
+        else:
+            k = max(1, int(np.ceil(F * frac)))
+            chosen = self._rng_feature.choice(F, size=k, replace=False)
+            mask[chosen] = True
         return jnp.asarray(mask)
 
     def _bagging_masks(self) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -239,6 +239,10 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # boosting iterations fused into one device dispatch (lax.scan) when
     # the pure-jit path applies (no callbacks/valid sets/host bagging)
     "tpu_fuse_iters": _P("int", 10, [], (1, 1000)),
+    # data-parallel histogram reduction: "scatter" (psum_scatter, each
+    # device owns F/D features — the reference's ReduceScatter layout) or
+    # "psum" (full replicated reduce)
+    "tpu_hist_reduce": _P("str", "scatter"),
 }
 
 # alias -> canonical name
@@ -373,6 +377,17 @@ class Config:
             # upstream maps boosting=goss -> gbdt + data_sample_strategy=goss
             self.boosting = "gbdt"
             self.data_sample_strategy = "goss"
+        learner_aliases = {"serial": "serial", "feature": "feature",
+                           "feature_parallel": "feature", "data": "data",
+                           "data_parallel": "data", "voting": "voting",
+                           "voting_parallel": "voting"}
+        tl = str(self.tree_learner).lower()
+        if tl not in learner_aliases:
+            log.fatal(f"Unknown tree learner type {self.tree_learner}")
+        self.tree_learner = learner_aliases[tl]
+        if str(self.tpu_hist_reduce) not in ("scatter", "psum"):
+            log.fatal(f"Unknown tpu_hist_reduce {self.tpu_hist_reduce!r} "
+                      f"(expected 'scatter' or 'psum')")
         dev = str(self.device_type).lower()
         # cpu/gpu/cuda requests run on the TPU/XLA backend here
         if dev in ("cpu", "gpu", "cuda"):

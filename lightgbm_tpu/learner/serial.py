@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from ..ops.pallas_histogram import (multi_leaf_histogram,
                                     multi_leaf_histogram_xla)
 from ..ops.split import (NEG_INF, SplitConfig, calc_leaf_output,
-                         find_best_split)
+                         elect_best, find_best_split, per_feature_gains)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +63,22 @@ class GrowConfig:
     use_pallas: bool = False
     # mesh axis for data-parallel histogram reduction ("" = single device)
     axis_name: str = ""
+    # -- distributed modes (SURVEY.md §3.4) ---------------------------
+    # data-parallel + hist_scatter: ReduceScatter feature ownership —
+    # each device reduces/owns F/num_shards features, finds its local
+    # best, and the winner is elected by all_gather
+    # (data_parallel_tree_learner.cpp)
+    hist_scatter: bool = False
+    num_shards: int = 1
+    # data-parallel + voting: PV-Tree — local top_k feature votes,
+    # global top-2k elected, only elected columns psum'd
+    # (voting_parallel_tree_learner.cpp)
+    voting: bool = False
+    top_k: int = 20
+    # feature-parallel: rows replicated, feature columns sharded over
+    # this axis; split search local, winner elected, partition via
+    # ownership-psum (feature_parallel_tree_learner.cpp)
+    feature_axis: str = ""
     # categorical split search (zero-cost when has_categorical=False)
     has_categorical: bool = False
     max_cat_threshold: int = 32
@@ -159,12 +175,36 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     Returns:
       (tree dict of fixed-size arrays + ``num_leaves``, per-row leaf_id).
     """
-    n_rows, F = bins.shape
+    n_rows, F = bins.shape          # F = LOCAL width under feature_axis
     L = cfg.num_leaves
     B = cfg.num_bins
     Kb = max(1, min(cfg.leaf_batch, L))
     i32 = jnp.int32
     scfg = cfg.split_config
+
+    # ---- distributed search modes (SURVEY.md §3.4) -------------------
+    mode_feature = bool(cfg.feature_axis)
+    mode_voting = bool(cfg.axis_name) and cfg.voting
+    mode_scatter = (bool(cfg.axis_name) and cfg.hist_scatter
+                    and not cfg.voting and cfg.num_shards > 1
+                    and F % cfg.num_shards == 0 and not mode_feature)
+    if mode_scatter:
+        F_s = F // cfg.num_shards       # owned feature slice per device
+    else:
+        F_s = F
+
+    def hist_reduce(h):
+        """Mode-specific cross-device histogram reduction."""
+        if mode_scatter:
+            # the reference's ReduceScatter: each device receives the
+            # summed histograms of the features it owns
+            return jax.lax.psum_scatter(h, cfg.axis_name,
+                                        scatter_dimension=1, tiled=True)
+        if mode_voting or mode_feature or not cfg.axis_name:
+            # voting reduces only elected columns later; feature-parallel
+            # and serial histograms are already complete
+            return h
+        return jax.lax.psum(h, cfg.axis_name)
 
     if cfg.use_pallas:
         if bins_t is None:
@@ -183,27 +223,86 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         pr = math.gcd(cfg.rows_per_block, 2048)
 
         def hist_multi(leaf_id, small_ids):
-            h = multi_leaf_histogram(bins_t, vals_t, leaf_id, small_ids,
-                                     num_bins=B, rows_per_block=pr)
-            if cfg.axis_name:
-                h = jax.lax.psum(h, cfg.axis_name)
-            return h
+            return hist_reduce(multi_leaf_histogram(
+                bins_t, vals_t, leaf_id, small_ids, num_bins=B,
+                rows_per_block=pr))
     else:
         def hist_multi(leaf_id, small_ids):
-            h = multi_leaf_histogram_xla(bins, vals, leaf_id, small_ids,
-                                         num_bins=B,
-                                         rows_per_block=cfg.rows_per_block)
-            if cfg.axis_name:
-                h = jax.lax.psum(h, cfg.axis_name)
-            return h
+            return hist_reduce(multi_leaf_histogram_xla(
+                bins, vals, leaf_id, small_ids, num_bins=B,
+                rows_per_block=cfg.rows_per_block))
 
     W = cfg.cat_words
     if not cfg.has_categorical:
         is_cat = None
-    best_fn = functools.partial(
-        find_best_split, num_bin=feat_num_bin, has_nan=feat_has_nan,
-        allowed_feature=allowed_feature, cfg=scfg, is_cat=is_cat)
-    best_vfn = jax.vmap(lambda h, s: best_fn(h, s))
+
+    # search-slice metadata: under scatter/feature-parallel each device
+    # searches only the F_s features it owns, offset into the GLOBAL
+    # feature index space
+    if mode_scatter or mode_feature:
+        _ax = cfg.axis_name if mode_scatter else cfg.feature_axis
+        off = (jax.lax.axis_index(_ax) * F_s).astype(i32)
+        nb_s = jax.lax.dynamic_slice_in_dim(feat_num_bin, off, F_s)
+        hn_s = jax.lax.dynamic_slice_in_dim(feat_has_nan, off, F_s)
+        al_s = jax.lax.dynamic_slice_in_dim(allowed_feature, off, F_s)
+        ic_s = (jax.lax.dynamic_slice_in_dim(is_cat, off, F_s)
+                if is_cat is not None else None)
+    else:
+        off = jnp.zeros((), i32)
+        nb_s, hn_s, al_s, ic_s = (feat_num_bin, feat_has_nan,
+                                  allowed_feature, is_cat)
+
+    def search_best(hists, sums):
+        """Best split per child: ``hists [C, F_h, B, 3]`` (mode-reduced),
+        ``sums [C, 3]`` global leaf totals. Returns per-child best dict
+        with GLOBAL feature indices, identical on every device."""
+        if mode_voting:
+            # PV-Tree (voting_parallel_tree_learner.cpp): vote with
+            # LOCAL histograms + local totals, elect global top-2k by
+            # vote count, reduce only those columns
+            C = hists.shape[0]
+            local_sums = jnp.sum(hists[:, 0], axis=1)        # [C, 3]
+            pf = jax.vmap(lambda h, s: per_feature_gains(
+                h, s, feat_num_bin, feat_has_nan, allowed_feature, scfg,
+                is_cat))(hists, local_sums)                  # [C, F]
+            k_ = min(cfg.top_k, F)
+            vk = min(2 * cfg.top_k, F)
+            _, top_local = jax.lax.top_k(pf, k_)             # [C, k]
+            votes = jnp.zeros((C, F), jnp.float32).at[
+                jnp.arange(C)[:, None], top_local].add(1.0)
+            votes = jax.lax.psum(votes, cfg.axis_name)
+            _, elected = jax.lax.top_k(votes, vk)            # [C, vk]
+            hist_e = jnp.take_along_axis(
+                hists, elected[:, :, None, None], axis=1)
+            hist_e = jax.lax.psum(hist_e, cfg.axis_name)
+            nb_e, hn_e, al_e = (feat_num_bin[elected],
+                                feat_has_nan[elected],
+                                allowed_feature[elected])
+            if is_cat is not None:
+                best = jax.vmap(lambda h, s, nb, hn, al, ic:
+                                find_best_split(h, s, nb, hn, al, scfg,
+                                                ic))(
+                    hist_e, sums, nb_e, hn_e, al_e, is_cat[elected])
+            else:
+                best = jax.vmap(lambda h, s, nb, hn, al:
+                                find_best_split(h, s, nb, hn, al, scfg))(
+                    hist_e, sums, nb_e, hn_e, al_e)
+            best["feature"] = jnp.take_along_axis(
+                elected, best["feature"][:, None], axis=1)[:, 0]
+            return best
+        if is_cat is not None:
+            best = jax.vmap(lambda h, s: find_best_split(
+                h, s, nb_s, hn_s, al_s, scfg, ic_s))(hists, sums)
+        else:
+            best = jax.vmap(lambda h, s: find_best_split(
+                h, s, nb_s, hn_s, al_s, scfg))(hists, sums)
+        best["feature"] = best["feature"] + off
+        if mode_scatter:
+            # SyncUpGlobalBestSplit across feature owners
+            return elect_best(best, cfg.axis_name)
+        if mode_feature:
+            return elect_best(best, cfg.feature_axis)
+        return best
 
     def leaf_out(sums):
         return calc_leaf_output(sums[..., 0], sums[..., 1], cfg.lambda_l1,
@@ -218,7 +317,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     root_sums = jnp.sum(vals, axis=0)
     if cfg.axis_name:
         root_sums = jax.lax.psum(root_sums, cfg.axis_name)
-    root_best = best_fn(root_hist, root_sums)
+    root_best = jax.tree.map(
+        lambda a: a[0], search_best(root_hist[None], root_sums[None]))
 
     def set0(arr, value):
         return arr.at[0].set(value)
@@ -228,7 +328,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         num_leaves=jnp.array(1, i32),
         has_split=jnp.isfinite(root_best["gain"]),
         leaf_id=leaf_id0,
-        leaf_hist=set0(jnp.zeros((L + 1, F, B, 3), jnp.float32),
+        leaf_hist=set0(jnp.zeros((L + 1,) + root_hist.shape, jnp.float32),
                        root_hist),
         leaf_sums=set0(jnp.zeros((L + 1, 3), jnp.float32), root_sums),
         leaf_depth=jnp.zeros(L + 1, i32),
@@ -321,9 +421,18 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         nb_r = row_attr[:, 4].astype(i32)
         hn_r = row_attr[:, 5] > 0.5
         # bins[row, feat_r] without a per-row gather: one-hot over F,
-        # fused compare-select-reduce on the VPU (exact in int32)
-        oh_f = feat_r[:, None] == jnp.arange(F, dtype=i32)[None, :]
+        # fused compare-select-reduce on the VPU (exact in int32). Under
+        # feature-parallel, only the winning feature's OWNER has the
+        # column — its contribution is broadcast by the psum (every
+        # other device contributes zeros), the TPU-native replacement
+        # for the reference's full-data local split.
+        col_ids = jnp.arange(F, dtype=i32)
+        if mode_feature:
+            col_ids = col_ids + off
+        oh_f = feat_r[:, None] == col_ids[None, :]
         col = jnp.sum(jnp.where(oh_f, bins.astype(i32), 0), axis=1)
+        if mode_feature:
+            col = jax.lax.psum(col, cfg.feature_axis)
         is_missing = hn_r & (col == nb_r - 1)
         goes_left = jnp.where(is_missing, dl_r, col <= thr_r)
         if cfg.has_categorical:
@@ -360,7 +469,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         # ---- best splits for all 2*Kb children -------------------------
         child_hists = jnp.concatenate([left_hist, right_hist])
         child_sums = jnp.concatenate([lsums, rsums])
-        bests = best_vfn(child_hists, child_sums)
+        bests = search_best(child_hists, child_sums)
         ids2 = jnp.concatenate([tl_safe, new_ids])
 
         depth2 = s.leaf_depth[tl_safe] + 1

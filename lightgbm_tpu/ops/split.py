@@ -85,23 +85,11 @@ def _pack_bitset(inset: jax.Array, n_words: int) -> jax.Array:
                    axis=1, dtype=jnp.uint32)
 
 
-def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
-                      cfg: SplitConfig):
-    """Best categorical split (one-hot + sorted many-vs-many).
-
-    Reference: ``FindBestThresholdCategoricalInner``
-    (src/treelearner/feature_histogram.hpp, UNVERIFIED): features with
-    few categories scan one-vs-rest; otherwise categories are sorted by
-    ``sum_grad / (sum_hess + cat_smooth)`` and prefixes of the sorted
-    order (both directions, capped at ``max_cat_threshold``) form the
-    left set, with ``cat_l2`` added to the L2 term.
-    ``min_data_per_group`` is applied to both children of a categorical
-    split. Bin 0 (the NaN/unseen-category bin) is never elected into a
-    left set — unseen categories route right at predict, matching the
-    bitset-miss semantics of the reference.
-
-    Returns (gain [scalar], feature, left_sums, inset [B] bool over bins).
-    """
+def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
+                            is_cat, cfg: SplitConfig):
+    """Candidate categorical gains: ``(all_gain [F, 3, B], orders
+    [F, 2, B], cum [F, 2, B, 3], valid_bin [F, B])`` — modes are
+    (one-hot, sorted-asc, sorted-desc)."""
     f, b, _ = hist.shape
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]
     cnt = hist[..., 2]
@@ -149,9 +137,32 @@ def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
         & ~use_onehot[:, None, None] & cat_ok[:, None, None],
         gain_sorted, NEG_INF)                                 # [F, 2, B]
 
-    # ---- pick the best candidate -------------------------------------
     all_gain = jnp.concatenate(
         [gain_oh[:, None, :], gain_sorted], axis=1)           # [F, 3, B]
+    return all_gain, orders, cum, valid_bin
+
+
+def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
+                      cfg: SplitConfig):
+    """Best categorical split (one-hot + sorted many-vs-many).
+
+    Reference: ``FindBestThresholdCategoricalInner``
+    (src/treelearner/feature_histogram.hpp, UNVERIFIED): features with
+    few categories scan one-vs-rest; otherwise categories are sorted by
+    ``sum_grad / (sum_hess + cat_smooth)`` and prefixes of the sorted
+    order (both directions, capped at ``max_cat_threshold``) form the
+    left set, with ``cat_l2`` added to the L2 term.
+    ``min_data_per_group`` is applied to both children of a categorical
+    split. Bin 0 (the NaN/unseen-category bin) is never elected into a
+    left set — unseen categories route right at predict, matching the
+    bitset-miss semantics of the reference.
+
+    Returns (gain [scalar], feature, left_sums, inset [B] bool over bins).
+    """
+    f, b, _ = hist.shape
+    bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]
+    all_gain, orders, cum, valid_bin = _categorical_candidates(
+        hist, parent_sums, num_bin, allowed_feature, is_cat, cfg)
     flat = all_gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -172,38 +183,14 @@ def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
     return best_gain, feature, left_sums, inset
 
 
-def find_best_split(hist: jax.Array, parent_sums: jax.Array,
-                    num_bin: jax.Array, has_nan: jax.Array,
-                    allowed_feature: jax.Array,
-                    cfg: SplitConfig,
-                    is_cat: jax.Array = None) -> Dict[str, jax.Array]:
-    """Best split for one leaf given its histogram.
-
-    Args:
-      hist: ``[F, B, 3]`` float32 — (sum_grad, sum_hess, count) per bin.
-      parent_sums: ``[3]`` — leaf totals (grad, hess, count).
-      num_bin: ``[F]`` int32 — bins actually used per feature (incl. NaN bin).
-      has_nan: ``[F]`` bool — whether the LAST used bin is the NaN bin.
-      allowed_feature: ``[F]`` bool — column-sampling / interaction mask.
-      cfg: static hyperparameters.
-      is_cat: ``[F]`` bool — categorical features (scanned by
-        ``_categorical_best`` instead of the threshold scan). Only read
-        when ``cfg.has_categorical``.
-
-    Returns dict of scalars: ``gain`` (−inf if no valid split), ``feature``,
-    ``threshold_bin`` (split sends ``bin <= t`` left), ``default_left``,
-    ``left_sums``/``right_sums`` (each ``[3]``), ``is_cat`` (categorical
-    split?) and ``cat_bitset`` (``[ceil(B/32)]`` uint32 left-set over bins).
-    """
+def _numerical_candidates(hist, parent_sums, num_bin, has_nan,
+                          num_allowed, cfg: SplitConfig):
+    """Numerical threshold-scan gains: ``(gain [F, B, 2],
+    left [F, B, 2, 3])`` — dir 0: missing right, dir 1: missing left."""
     f, b, _ = hist.shape
-    n_words = (b + 31) // 32
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]          # [1, B]
     nan_bin = (num_bin - 1)[:, None]                           # [F, 1]
     is_nan_bin = has_nan[:, None] & (bin_idx == nan_bin)       # [F, B]
-
-    num_allowed = allowed_feature
-    if cfg.has_categorical and is_cat is not None:
-        num_allowed = allowed_feature & ~is_cat
 
     hist_vals = jnp.where(is_nan_bin[..., None], 0.0, hist)
     nan_sums = jnp.sum(jnp.where(is_nan_bin[..., None], hist, 0.0),
@@ -234,8 +221,80 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
              & (lh >= cfg.min_sum_hessian_in_leaf)
              & (rh >= cfg.min_sum_hessian_in_leaf)
              & (gain > cfg.min_gain_to_split))
-    gain = jnp.where(valid, gain, NEG_INF)
+    return jnp.where(valid, gain, NEG_INF), left
 
+
+def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
+                      num_bin: jax.Array, has_nan: jax.Array,
+                      allowed_feature: jax.Array, cfg: SplitConfig,
+                      is_cat: jax.Array = None) -> jax.Array:
+    """Best achievable gain per feature (``[F]``) — the local VOTE metric
+    of the voting-parallel learner (PV-Tree,
+    voting_parallel_tree_learner.cpp: machines propose their top-k
+    features by local best gain)."""
+    num_allowed = allowed_feature
+    if cfg.has_categorical and is_cat is not None:
+        num_allowed = allowed_feature & ~is_cat
+    gain, _ = _numerical_candidates(hist, parent_sums, num_bin, has_nan,
+                                    num_allowed, cfg)
+    pf = jnp.max(gain, axis=(1, 2))                            # [F]
+    if cfg.has_categorical and is_cat is not None:
+        all_gain, _, _, _ = _categorical_candidates(
+            hist, parent_sums, num_bin, allowed_feature, is_cat, cfg)
+        pf = jnp.maximum(pf, jnp.max(all_gain, axis=(1, 2)))
+    return pf
+
+
+def elect_best(best: Dict[str, jax.Array],
+               axis_name: str) -> Dict[str, jax.Array]:
+    """Cross-device election of per-child best splits: all_gather the
+    records over the mesh axis and keep the max-gain device's entry per
+    child — the reference's ``SyncUpGlobalBestSplit`` (AllGather of
+    serialized SplitInfo + max-gain pick, parallel_tree_learner.h).
+    ``best`` fields carry a leading child dim ``[C]``; ``feature`` must
+    already be a GLOBAL index."""
+    gathered = jax.lax.all_gather(best, axis_name)             # [D, C, ...]
+    win = jnp.argmax(gathered["gain"], axis=0)                 # [C]
+
+    def take(a):
+        idx = win.reshape((1, -1) + (1,) * (a.ndim - 2))
+        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=0)[0]
+
+    return jax.tree.map(take, gathered)
+
+
+def find_best_split(hist: jax.Array, parent_sums: jax.Array,
+                    num_bin: jax.Array, has_nan: jax.Array,
+                    allowed_feature: jax.Array,
+                    cfg: SplitConfig,
+                    is_cat: jax.Array = None) -> Dict[str, jax.Array]:
+    """Best split for one leaf given its histogram.
+
+    Args:
+      hist: ``[F, B, 3]`` float32 — (sum_grad, sum_hess, count) per bin.
+      parent_sums: ``[3]`` — leaf totals (grad, hess, count).
+      num_bin: ``[F]`` int32 — bins actually used per feature (incl. NaN bin).
+      has_nan: ``[F]`` bool — whether the LAST used bin is the NaN bin.
+      allowed_feature: ``[F]`` bool — column-sampling / interaction mask.
+      cfg: static hyperparameters.
+      is_cat: ``[F]`` bool — categorical features (scanned by
+        ``_categorical_best`` instead of the threshold scan). Only read
+        when ``cfg.has_categorical``.
+
+    Returns dict of scalars: ``gain`` (−inf if no valid split), ``feature``,
+    ``threshold_bin`` (split sends ``bin <= t`` left), ``default_left``,
+    ``left_sums``/``right_sums`` (each ``[3]``), ``is_cat`` (categorical
+    split?) and ``cat_bitset`` (``[ceil(B/32)]`` uint32 left-set over bins).
+    """
+    f, b, _ = hist.shape
+    n_words = (b + 31) // 32
+
+    num_allowed = allowed_feature
+    if cfg.has_categorical and is_cat is not None:
+        num_allowed = allowed_feature & ~is_cat
+
+    gain, left = _numerical_candidates(hist, parent_sums, num_bin,
+                                       has_nan, num_allowed, cfg)
     flat = gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]

@@ -47,6 +47,15 @@ def create_data_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs), (DATA_AXIS,))
 
 
+def create_feature_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the feature axis (columns sharded, rows replicated)
+    — the feature-parallel learner's layout."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (FEATURE_AXIS,))
+
+
 def create_2d_mesh(data: int, feature: int) -> Mesh:
     """2-D mesh for combined data x feature sharding (voting/feature
     learners at scale)."""

@@ -168,7 +168,8 @@ def create_tree_digraph(booster, tree_index: int = 0,
     if not 0 <= tree_index < len(model.trees):
         raise IndexError(f"tree_index {tree_index} out of range "
                          f"(0..{len(model.trees) - 1})")
-    return _tree_to_graph(model, tree_index, precision=precision)
+    return _tree_to_graph(model, tree_index, precision=precision,
+                          **kwargs)
 
 
 def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None,

@@ -248,8 +248,14 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         self.classes_ = np.unique(y_arr)
         self.n_classes_ = len(self.classes_)
         if self.n_classes_ > 2 and not callable(self.objective):
-            self._other_params.setdefault("num_class", self.n_classes_)
+            self._other_params["num_class"] = self.n_classes_
             setattr(self, "num_class", self.n_classes_)
+        else:
+            # a previous multiclass fit must not leak its class count
+            # into a binary refit
+            self._other_params.pop("num_class", None)
+            if hasattr(self, "num_class"):
+                del self.num_class
         return super().fit(X, y, **kwargs)
 
     def predict_proba(self, X, raw_score: bool = False,

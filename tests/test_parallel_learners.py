@@ -1,0 +1,118 @@
+"""Feature-parallel + voting-parallel learners on a fake 8-device CPU
+mesh, and scatter-vs-psum data-parallel equivalence.
+
+Reference semantics (SURVEY.md §3.4, UNVERIFIED):
+- feature_parallel_tree_learner.cpp: full rows everywhere, split search
+  sharded by feature, SyncUpGlobalBestSplit election
+- voting_parallel_tree_learner.cpp (PV-Tree): local top-k votes, global
+  top-2k elected, only elected features' histograms reduced
+- data_parallel_tree_learner.cpp: ReduceScatter feature ownership
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=3000, f=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = (X @ w + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _train_pred(X, y, learner, extra=None):
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "tree_learner": learner}
+    params.update(extra or {})
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, ds, num_boost_round=10)
+    return bst, bst.predict(X)
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p)); ranks[order] = np.arange(1, len(p) + 1)
+    pos = y > 0
+    return (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) \
+        / (pos.sum() * (~pos).sum())
+
+
+def test_feature_parallel_matches_serial():
+    """Feature-parallel elects the same splits as serial (identical
+    histograms, just sharded search) — predictions near-identical."""
+    X, y = _binary_data(seed=3)
+    bst_s, p_s = _train_pred(X, y, "serial")
+    bst_f, p_f = _train_pred(X, y, "feature")
+    assert bst_f.engine.mesh is not None
+    assert bst_f.engine.learner_type == "feature"
+    np.testing.assert_allclose(p_s, p_f, rtol=2e-2, atol=2e-3)
+    assert abs(_auc(y, p_s) - _auc(y, p_f)) < 0.005
+
+
+def test_feature_parallel_uneven_features():
+    """F=10 on 8 devices: padded feature slots must never win splits."""
+    X, y = _binary_data(f=10, seed=4)
+    bst, p = _train_pred(X, y, "feature")
+    assert _auc(y, p) > 0.9
+    for t in bst.engine.models:
+        assert np.all(t.split_feature < 10)
+
+
+def test_voting_parallel_trains_well():
+    X, y = _binary_data(n=4000, f=16, seed=5)
+    bst, p = _train_pred(X, y, "voting", {"top_k": 5})
+    assert bst.engine.learner_type == "voting"
+    assert _auc(y, p) > 0.9
+
+
+def test_voting_matches_data_parallel_when_topk_covers_all():
+    """With top_k >= F every feature is elected, so voting degenerates to
+    exact data-parallel — predictions must match serial closely."""
+    X, y = _binary_data(n=2000, f=6, seed=6)
+    _, p_s = _train_pred(X, y, "serial")
+    _, p_v = _train_pred(X, y, "voting", {"top_k": 6})
+    np.testing.assert_allclose(p_s, p_v, rtol=2e-2, atol=2e-3)
+    assert abs(_auc(y, p_s) - _auc(y, p_v)) < 0.005
+
+
+def test_scatter_matches_psum():
+    """ReduceScatter feature-ownership reduce == full-psum reduce."""
+    X, y = _binary_data(n=2000, f=7, seed=7)
+    _, p_scatter = _train_pred(X, y, "data",
+                               {"tpu_hist_reduce": "scatter"})
+    _, p_psum = _train_pred(X, y, "data", {"tpu_hist_reduce": "psum"})
+    np.testing.assert_allclose(p_scatter, p_psum, rtol=2e-2, atol=2e-3)
+    assert abs(_auc(y, p_scatter) - _auc(y, p_psum)) < 0.005
+
+
+def test_feature_parallel_with_goss_and_valid():
+    X, y = _binary_data(n=3000, f=9, seed=8)
+    ds = lgb.Dataset(X[:2400], label=y[:2400])
+    vs = ds.create_valid(X[2400:], label=y[2400:])
+    res = {}
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "tree_learner": "feature", "data_sample_strategy": "goss",
+         "metric": "auc"},
+        ds, num_boost_round=15, valid_sets=[vs],
+        callbacks=[lgb.record_evaluation(res)])
+    assert res["valid_0"]["auc"][-1] > 0.88
+
+
+def test_voting_with_categorical():
+    rng = np.random.default_rng(9)
+    n, n_cats = 3000, 12
+    cat = rng.integers(0, n_cats, size=n)
+    effect = rng.permutation(n_cats) >= n_cats // 2
+    y = (effect[cat].astype(float) * 2 - 1
+         + rng.normal(scale=0.5, size=n) > 0).astype(float)
+    X = np.column_stack([cat.astype(float), rng.normal(size=(n, 3))])
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+         "tree_learner": "voting", "top_k": 3, "min_data_per_group": 5,
+         "cat_smooth": 1.0},
+        lgb.Dataset(X, label=y, categorical_feature=[0]),
+        num_boost_round=8)
+    assert _auc(y, bst.predict(X)) > 0.85
