@@ -199,6 +199,35 @@ class GBDT:
         self.has_categorical = bool(is_cat.any())
         self.feat_is_cat = jnp.asarray(is_cat)
 
+        # monotone constraints ([F_pad] int8 by used-feature index;
+        # categorical features are never direction-constrained)
+        mc = list(config.monotone_constraints or [])
+        mono = np.zeros(self.F_pad, dtype=np.int8)
+        if mc:
+            for i, f in enumerate(self.train_set.used_features):
+                if f < len(mc):
+                    mono[i] = int(mc[f])
+            mono[is_cat] = 0
+        self.has_monotone = bool(np.any(mono != 0))
+        self.feat_mono = jnp.asarray(mono) if self.has_monotone else None
+
+        # interaction constraints ([G, F_pad] bool over used features)
+        from ..config import parse_interaction_constraints
+        groups_spec = parse_interaction_constraints(
+            config.interaction_constraints)
+        self.has_interaction = bool(groups_spec)
+        self.interaction_groups = None
+        if self.has_interaction:
+            orig_to_used = {f: i for i, f in
+                            enumerate(self.train_set.used_features)}
+            gm = np.zeros((len(groups_spec), self.F_pad), dtype=bool)
+            for gi, grp in enumerate(groups_spec):
+                for f in grp:
+                    u = orig_to_used.get(int(f))
+                    if u is not None:
+                        gm[gi, u] = True
+            self.interaction_groups = jnp.asarray(gm)
+
         # The fused Pallas kernel needs a TPU backend and int8-roundtrip
         # bin ids (B <= 256); anything else takes the XLA einsum path.
         self.use_pallas = bool(config.tpu_use_pallas and F > 0
@@ -332,6 +361,8 @@ class GBDT:
             voting=self.learner_type == "voting",
             top_k=config.top_k,
             feature_axis=(self.axis if self._shard_features else ""),
+            has_monotone=self.has_monotone,
+            has_interaction=self.has_interaction,
         )
 
     # ------------------------------------------------------------------
@@ -364,7 +395,8 @@ class GBDT:
                 tree, leaf_id = grow_tree(
                     bins, vals, self.feat_num_bin, self.feat_has_nan,
                     allowed, gcfg, bins_t=bins_t,
-                    is_cat=self.feat_is_cat)
+                    is_cat=self.feat_is_cat, mono=self.feat_mono,
+                    groups=self.interaction_groups)
                 # leaf_value[leaf_id] as a one-hot matmul: a per-row
                 # gather into a [L] table runs on the TPU scalar unit
                 # (~9ms/Mrow); the masked contraction is ~free on the MXU.
@@ -449,6 +481,12 @@ class GBDT:
                 out.append(new)
             return out
 
+        @jax.jit
+        def plain_valid_update(valid_scores, stacked_trees):
+            pairs = [(self.valid_data[i].bins, s)
+                     for i, s in enumerate(valid_scores)]
+            return valid_update_impl(pairs, stacked_trees)
+
         if mesh is None:
             d = self.data
 
@@ -467,11 +505,7 @@ class GBDT:
                 return step_custom_impl(d.bins, d.bins_t, score, g, h,
                                         mask_gh, mask_count, allowed)
 
-            @jax.jit
-            def valid_update(valid_scores, stacked_trees):
-                pairs = [(self.valid_data[i].bins, s)
-                         for i, s in enumerate(valid_scores)]
-                return valid_update_impl(pairs, stacked_trees)
+            valid_update = plain_valid_update
         else:
             # SPMD distributed: data/voting shard rows over the mesh axis
             # (histograms psum / psum_scatter / vote-reduce inside
@@ -543,11 +577,7 @@ class GBDT:
             if self._shard_features:
                 # feature-parallel valid sets are replicated (prediction
                 # needs all columns); plain jit, no shard_map
-                @jax.jit
-                def valid_update(valid_scores, stacked_trees):
-                    pairs = [(self.valid_data[i].bins, s)
-                             for i, s in enumerate(valid_scores)]
-                    return valid_update_impl(pairs, stacked_trees)
+                valid_update = plain_valid_update
             else:
                 @jax.jit
                 def valid_update(valid_scores, stacked_trees):

@@ -245,6 +245,19 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "tpu_hist_reduce": _P("str", "scatter"),
 }
 
+def parse_interaction_constraints(spec) -> List[List[int]]:
+    """Parse interaction_constraints: ``"[0,1,2],[2,3]"`` (reference CLI
+    form), a Python list of lists, or its str() — into feature-index
+    groups."""
+    if spec is None or spec == "" or spec == []:
+        return []
+    if isinstance(spec, (list, tuple)):
+        return [[int(f) for f in grp] for grp in spec]
+    import re
+    return [[int(x) for x in grp.replace(" ", "").split(",") if x != ""]
+            for grp in re.findall(r"\[([\d,\s]*)\]", str(spec))]
+
+
 # alias -> canonical name
 _ALIASES: Dict[str, str] = {}
 for _name, (_t, _d, _al, _b) in _PARAMS.items():
@@ -388,6 +401,10 @@ class Config:
         if str(self.tpu_hist_reduce) not in ("scatter", "psum"):
             log.fatal(f"Unknown tpu_hist_reduce {self.tpu_hist_reduce!r} "
                       f"(expected 'scatter' or 'psum')")
+        for m in (self.monotone_constraints or []):
+            if int(m) not in (-1, 0, 1):
+                log.fatal("monotone_constraints must be -1, 0 or 1, "
+                          f"got {m}")
         dev = str(self.device_type).lower()
         # cpu/gpu/cuda requests run on the TPU/XLA backend here
         if dev in ("cpu", "gpu", "cuda"):

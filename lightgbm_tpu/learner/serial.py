@@ -79,6 +79,10 @@ class GrowConfig:
     # this axis; split search local, winner elected, partition via
     # ownership-psum (feature_parallel_tree_learner.cpp)
     feature_axis: str = ""
+    # constraints (monotone_constraints.hpp basic mode; ColSampler
+    # interaction constraints): zero-cost when False
+    has_monotone: bool = False
+    has_interaction: bool = False
     # categorical split search (zero-cost when has_categorical=False)
     has_categorical: bool = False
     max_cat_threshold: int = 32
@@ -104,7 +108,8 @@ class GrowConfig:
             max_cat_threshold=self.max_cat_threshold,
             cat_smooth=self.cat_smooth, cat_l2=self.cat_l2,
             max_cat_to_onehot=self.max_cat_to_onehot,
-            min_data_per_group=self.min_data_per_group)
+            min_data_per_group=self.min_data_per_group,
+            has_monotone=self.has_monotone)
 
 
 class GrowState(NamedTuple):
@@ -141,6 +146,12 @@ class GrowState(NamedTuple):
     leaf_weight: jnp.ndarray
     leaf_parent: jnp.ndarray
     leaf_is_left: jnp.ndarray
+    # monotone "basic" bounds ([L+1]; ±inf when unconstrained) and
+    # interaction-constraint path features ([L+1, F or 1-dummy]; the
+    # per-leaf allowed set is derived from this at split time)
+    leaf_lower: jnp.ndarray
+    leaf_upper: jnp.ndarray
+    leaf_used: jnp.ndarray
 
 
 def _masked_gains(gain, leaf_depth, num_leaves, max_depth):
@@ -158,6 +169,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               allowed_feature: jax.Array, cfg: GrowConfig,
               bins_t: jax.Array = None,
               is_cat: jax.Array = None,
+              mono: jax.Array = None,
+              groups: jax.Array = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -235,6 +248,11 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     W = cfg.cat_words
     if not cfg.has_categorical:
         is_cat = None
+    if not cfg.has_monotone:
+        mono = None
+    if not cfg.has_interaction:
+        groups = None
+    F_meta = feat_num_bin.shape[0]      # GLOBAL feature count
 
     # search-slice metadata: under scatter/feature-parallel each device
     # searches only the F_s features it owns, offset into the GLOBAL
@@ -247,24 +265,34 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         al_s = jax.lax.dynamic_slice_in_dim(allowed_feature, off, F_s)
         ic_s = (jax.lax.dynamic_slice_in_dim(is_cat, off, F_s)
                 if is_cat is not None else None)
+        mn_s = (jax.lax.dynamic_slice_in_dim(mono, off, F_s)
+                if mono is not None else None)
     else:
         off = jnp.zeros((), i32)
-        nb_s, hn_s, al_s, ic_s = (feat_num_bin, feat_has_nan,
-                                  allowed_feature, is_cat)
+        nb_s, hn_s, al_s, ic_s, mn_s = (feat_num_bin, feat_has_nan,
+                                        allowed_feature, is_cat, mono)
 
-    def search_best(hists, sums):
+    def search_best(hists, sums, lowers=None, uppers=None, allows=None):
         """Best split per child: ``hists [C, F_h, B, 3]`` (mode-reduced),
-        ``sums [C, 3]`` global leaf totals. Returns per-child best dict
-        with GLOBAL feature indices, identical on every device."""
+        ``sums [C, 3]`` global leaf totals, optional per-child monotone
+        output bounds (``[C]``) and interaction-constrained allowed
+        masks (``[C, F_meta]``, GLOBAL width). Returns per-child best
+        dict with GLOBAL feature indices, identical on every device."""
+        C = hists.shape[0]
+        if lowers is None:
+            lowers = jnp.full(C, -jnp.inf, jnp.float32)
+            uppers = jnp.full(C, jnp.inf, jnp.float32)
+        allows_g = (jnp.broadcast_to(allowed_feature, (C, F_meta))
+                    if allows is None else allows)
         if mode_voting:
             # PV-Tree (voting_parallel_tree_learner.cpp): vote with
             # LOCAL histograms + local totals, elect global top-2k by
             # vote count, reduce only those columns
-            C = hists.shape[0]
             local_sums = jnp.sum(hists[:, 0], axis=1)        # [C, 3]
-            pf = jax.vmap(lambda h, s: per_feature_gains(
-                h, s, feat_num_bin, feat_has_nan, allowed_feature, scfg,
-                is_cat))(hists, local_sums)                  # [C, F]
+            pf = jax.vmap(lambda h, s, al, lo, hi: per_feature_gains(
+                h, s, feat_num_bin, feat_has_nan, al, scfg, is_cat,
+                mono=mono, out_lower=lo, out_upper=hi))(
+                hists, local_sums, allows_g, lowers, uppers)  # [C, F]
             k_ = min(cfg.top_k, F)
             vk = min(2 * cfg.top_k, F)
             _, top_local = jax.lax.top_k(pf, k_)             # [C, k]
@@ -275,27 +303,26 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             hist_e = jnp.take_along_axis(
                 hists, elected[:, :, None, None], axis=1)
             hist_e = jax.lax.psum(hist_e, cfg.axis_name)
-            nb_e, hn_e, al_e = (feat_num_bin[elected],
-                                feat_has_nan[elected],
-                                allowed_feature[elected])
-            if is_cat is not None:
-                best = jax.vmap(lambda h, s, nb, hn, al, ic:
-                                find_best_split(h, s, nb, hn, al, scfg,
-                                                ic))(
-                    hist_e, sums, nb_e, hn_e, al_e, is_cat[elected])
-            else:
-                best = jax.vmap(lambda h, s, nb, hn, al:
-                                find_best_split(h, s, nb, hn, al, scfg))(
-                    hist_e, sums, nb_e, hn_e, al_e)
+            nb_e, hn_e = feat_num_bin[elected], feat_has_nan[elected]
+            al_e = jnp.take_along_axis(allows_g, elected, axis=1)
+            ic_e = is_cat[elected] if is_cat is not None else None
+            mn_e = mono[elected] if mono is not None else None
+            best = jax.vmap(
+                lambda h, s, nb, hn, al, ic, mn, lo, hi: find_best_split(
+                    h, s, nb, hn, al, scfg, is_cat=ic, mono=mn,
+                    out_lower=lo, out_upper=hi))(
+                hist_e, sums, nb_e, hn_e, al_e, ic_e, mn_e,
+                lowers, uppers)
             best["feature"] = jnp.take_along_axis(
                 elected, best["feature"][:, None], axis=1)[:, 0]
             return best
-        if is_cat is not None:
-            best = jax.vmap(lambda h, s: find_best_split(
-                h, s, nb_s, hn_s, al_s, scfg, ic_s))(hists, sums)
-        else:
-            best = jax.vmap(lambda h, s: find_best_split(
-                h, s, nb_s, hn_s, al_s, scfg))(hists, sums)
+        allows_s = (jax.lax.dynamic_slice_in_dim(allows_g, off, F_s,
+                                                 axis=1)
+                    if (mode_scatter or mode_feature) else allows_g)
+        best = jax.vmap(lambda h, s, al, lo, hi: find_best_split(
+            h, s, nb_s, hn_s, al, scfg, is_cat=ic_s, mono=mn_s,
+            out_lower=lo, out_upper=hi))(
+            hists, sums, allows_s, lowers, uppers)
         best["feature"] = best["feature"] + off
         if mode_scatter:
             # SyncUpGlobalBestSplit across feature owners
@@ -317,8 +344,15 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     root_sums = jnp.sum(vals, axis=0)
     if cfg.axis_name:
         root_sums = jax.lax.psum(root_sums, cfg.axis_name)
+    if cfg.has_interaction:
+        # features in no constraint group can never be used
+        root_allow = jnp.any(groups, axis=0) & allowed_feature  # [F_meta]
+    else:
+        root_allow = None
     root_best = jax.tree.map(
-        lambda a: a[0], search_best(root_hist[None], root_sums[None]))
+        lambda a: a[0], search_best(
+            root_hist[None], root_sums[None],
+            allows=None if root_allow is None else root_allow[None]))
 
     def set0(arr, value):
         return arr.at[0].set(value)
@@ -362,6 +396,10 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         leaf_weight=set0(jnp.zeros(L + 1, jnp.float32), root_sums[1]),
         leaf_parent=jnp.full(L + 1, -1, i32),
         leaf_is_left=jnp.zeros(L + 1, jnp.bool_),
+        leaf_lower=jnp.full(L + 1, -jnp.inf, jnp.float32),
+        leaf_upper=jnp.full(L + 1, jnp.inf, jnp.float32),
+        leaf_used=jnp.zeros(
+            (L + 1, F_meta if cfg.has_interaction else 1), jnp.bool_),
     )
 
     node_trash = L - 1  # real nodes occupy 0..L-2
@@ -466,12 +504,6 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         leaf_hist = (s.leaf_hist.at[tl_safe].set(left_hist)
                      .at[new_ids].set(right_hist))
 
-        # ---- best splits for all 2*Kb children -------------------------
-        child_hists = jnp.concatenate([left_hist, right_hist])
-        child_sums = jnp.concatenate([lsums, rsums])
-        bests = search_best(child_hists, child_sums)
-        ids2 = jnp.concatenate([tl_safe, new_ids])
-
         depth2 = s.leaf_depth[tl_safe] + 1
         lvals = leaf_out(lsums)
         rvals = leaf_out(rsums)
@@ -487,6 +519,46 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             cat_split = s.best_is_cat[tl_safe]
             lvals = jnp.where(cat_split, leaf_out_cat(lsums), lvals)
             rvals = jnp.where(cat_split, leaf_out_cat(rsums), rvals)
+
+        # ---- constraint propagation (BasicLeafConstraints::Update) -----
+        if cfg.has_monotone:
+            m_k = mono[s.best_feature[tl_safe]].astype(jnp.float32)
+            plo = s.leaf_lower[tl_safe]
+            phi = s.leaf_upper[tl_safe]
+            lvals = jnp.clip(lvals, plo, phi)
+            rvals = jnp.clip(rvals, plo, phi)
+            # basic mode: the mid-point of the realized outputs becomes
+            # the shared bound of the two children, so any LATER split
+            # below either child cannot cross it
+            mid = 0.5 * (lvals + rvals)
+            lo_l = jnp.where(m_k < 0, jnp.maximum(plo, mid), plo)
+            hi_l = jnp.where(m_k > 0, jnp.minimum(phi, mid), phi)
+            lo_r = jnp.where(m_k > 0, jnp.maximum(plo, mid), plo)
+            hi_r = jnp.where(m_k < 0, jnp.minimum(phi, mid), phi)
+            child_lower = jnp.concatenate([lo_l, lo_r])
+            child_upper = jnp.concatenate([hi_l, hi_r])
+        else:
+            child_lower = child_upper = None
+        if cfg.has_interaction:
+            fk = s.best_feature[tl_safe]
+            used_k = s.leaf_used[tl_safe] \
+                | (fk[:, None] == jnp.arange(F_meta, dtype=i32)[None, :])
+            # a group is usable iff it contains EVERY feature on the path
+            viol = jnp.any(used_k[:, None, :] & ~groups[None],
+                           axis=2)                            # [Kb, G]
+            allow_k = jnp.any(groups[None] & ~viol[:, :, None],
+                              axis=1) & allowed_feature[None]  # [Kb, F]
+            child_used = jnp.concatenate([used_k, used_k])
+            child_allow = jnp.concatenate([allow_k, allow_k])
+        else:
+            child_used = child_allow = None
+
+        # ---- best splits for all 2*Kb children -------------------------
+        child_hists = jnp.concatenate([left_hist, right_hist])
+        child_sums = jnp.concatenate([lsums, rsums])
+        bests = search_best(child_hists, child_sums,
+                            child_lower, child_upper, child_allow)
+        ids2 = jnp.concatenate([tl_safe, new_ids])
 
         # ---- tree wiring -----------------------------------------------
         lc = s.left_child.at[node_ids].set(-top_leaf - 1)
@@ -548,6 +620,12 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             leaf_is_left=s.leaf_is_left.at[ids2].set(
                 jnp.concatenate([jnp.ones(Kb, jnp.bool_),
                                  jnp.zeros(Kb, jnp.bool_)])),
+            leaf_lower=(s.leaf_lower.at[ids2].set(child_lower)
+                        if cfg.has_monotone else s.leaf_lower),
+            leaf_upper=(s.leaf_upper.at[ids2].set(child_upper)
+                        if cfg.has_monotone else s.leaf_upper),
+            leaf_used=(s.leaf_used.at[ids2].set(child_used)
+                       if cfg.has_interaction else s.leaf_used),
         )
         next_gains = _masked_gains(new.best_gain, new.leaf_depth,
                                    new.num_leaves, cfg.max_depth)

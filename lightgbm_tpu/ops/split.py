@@ -46,6 +46,12 @@ class SplitConfig:
     cat_l2: float = 10.0
     max_cat_to_onehot: int = 4
     min_data_per_group: int = 100
+    # monotone constraints, "basic" method (monotone_constraints.hpp
+    # BasicLeafConstraints): child outputs are clipped to the leaf's
+    # inherited [lower, upper] range, gains are evaluated at the clipped
+    # outputs, and thresholds whose outputs violate the feature's
+    # direction are vetoed
+    has_monotone: bool = False
 
 
 def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
@@ -72,6 +78,15 @@ def calc_leaf_output(sum_g: jax.Array, sum_h: jax.Array, l1: float,
     if max_delta_step > 0.0:
         out = jnp.clip(out, -max_delta_step, max_delta_step)
     return out
+
+
+def leaf_gain_at_output(sum_g: jax.Array, sum_h: jax.Array, l1: float,
+                        l2: float, output: jax.Array) -> jax.Array:
+    """Leaf gain evaluated at a GIVEN (possibly clipped) output —
+    ``GetLeafSplitGainGivenOutput`` (feature_histogram.hpp): equals
+    ``leaf_gain`` when the output is the unconstrained optimum."""
+    t = threshold_l1(sum_g, l1)
+    return -(2.0 * t * output + (sum_h + l2) * output * output)
 
 
 def _pack_bitset(inset: jax.Array, n_words: int) -> jax.Array:
@@ -184,9 +199,15 @@ def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
 
 
 def _numerical_candidates(hist, parent_sums, num_bin, has_nan,
-                          num_allowed, cfg: SplitConfig):
+                          num_allowed, cfg: SplitConfig,
+                          mono=None, out_lower=None, out_upper=None):
     """Numerical threshold-scan gains: ``(gain [F, B, 2],
-    left [F, B, 2, 3])`` — dir 0: missing right, dir 1: missing left."""
+    left [F, B, 2, 3])`` — dir 0: missing right, dir 1: missing left.
+
+    With ``cfg.has_monotone``: ``mono [F]`` in {-1, 0, +1} and the
+    leaf's inherited output range ``[out_lower, out_upper]`` (scalars);
+    candidate outputs are clipped to the range, gains evaluated at the
+    clipped outputs, and direction-violating thresholds vetoed."""
     f, b, _ = hist.shape
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]          # [1, B]
     nan_bin = (num_bin - 1)[:, None]                           # [F, 1]
@@ -205,10 +226,35 @@ def _numerical_candidates(hist, parent_sums, num_bin, has_nan,
     lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
     rg, rh, rc = right[..., 0], right[..., 1], right[..., 2]
 
-    gain = (leaf_gain(lg, lh, cfg.lambda_l1, cfg.lambda_l2)
-            + leaf_gain(rg, rh, cfg.lambda_l1, cfg.lambda_l2)
-            - leaf_gain(parent_sums[0], parent_sums[1],
-                        cfg.lambda_l1, cfg.lambda_l2))
+    parent_gain = leaf_gain(parent_sums[0], parent_sums[1],
+                            cfg.lambda_l1, cfg.lambda_l2)
+    if cfg.has_monotone and mono is not None:
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        l_out = jnp.clip(calc_leaf_output(lg, lh, l1, l2,
+                                          cfg.max_delta_step),
+                         out_lower, out_upper)
+        r_out = jnp.clip(calc_leaf_output(rg, rh, l1, l2,
+                                          cfg.max_delta_step),
+                         out_lower, out_upper)
+        # the parent's gain must be evaluated at ITS clipped output too,
+        # or clipped leaves have every candidate gain deflated
+        p_out = jnp.clip(calc_leaf_output(parent_sums[0], parent_sums[1],
+                                          l1, l2, cfg.max_delta_step),
+                         out_lower, out_upper)
+        parent_gain_c = leaf_gain_at_output(parent_sums[0],
+                                            parent_sums[1], l1, l2, p_out)
+        gain = (leaf_gain_at_output(lg, lh, l1, l2, l_out)
+                + leaf_gain_at_output(rg, rh, l1, l2, r_out)
+                - parent_gain_c)
+        # veto thresholds that violate the feature's direction:
+        # +1 (increasing): left (smaller values) must not exceed right
+        violates = (mono[:, None, None].astype(jnp.float32)
+                    * (l_out - r_out)) > 0
+        gain = jnp.where(violates, NEG_INF, gain)
+    else:
+        gain = (leaf_gain(lg, lh, cfg.lambda_l1, cfg.lambda_l2)
+                + leaf_gain(rg, rh, cfg.lambda_l1, cfg.lambda_l2)
+                - parent_gain)
 
     n_value_bins = num_bin - has_nan.astype(jnp.int32)
     # thresholds t split value-bins {<=t} | {>t}; the extra slot when a NaN
@@ -227,7 +273,8 @@ def _numerical_candidates(hist, parent_sums, num_bin, has_nan,
 def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
                       num_bin: jax.Array, has_nan: jax.Array,
                       allowed_feature: jax.Array, cfg: SplitConfig,
-                      is_cat: jax.Array = None) -> jax.Array:
+                      is_cat: jax.Array = None, mono=None,
+                      out_lower=None, out_upper=None) -> jax.Array:
     """Best achievable gain per feature (``[F]``) — the local VOTE metric
     of the voting-parallel learner (PV-Tree,
     voting_parallel_tree_learner.cpp: machines propose their top-k
@@ -236,7 +283,9 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
     if cfg.has_categorical and is_cat is not None:
         num_allowed = allowed_feature & ~is_cat
     gain, _ = _numerical_candidates(hist, parent_sums, num_bin, has_nan,
-                                    num_allowed, cfg)
+                                    num_allowed, cfg, mono=mono,
+                                    out_lower=out_lower,
+                                    out_upper=out_upper)
     pf = jnp.max(gain, axis=(1, 2))                            # [F]
     if cfg.has_categorical and is_cat is not None:
         all_gain, _, _, _ = _categorical_candidates(
@@ -267,7 +316,9 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
                     num_bin: jax.Array, has_nan: jax.Array,
                     allowed_feature: jax.Array,
                     cfg: SplitConfig,
-                    is_cat: jax.Array = None) -> Dict[str, jax.Array]:
+                    is_cat: jax.Array = None, mono=None,
+                    out_lower=None, out_upper=None
+                    ) -> Dict[str, jax.Array]:
     """Best split for one leaf given its histogram.
 
     Args:
@@ -294,7 +345,9 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
         num_allowed = allowed_feature & ~is_cat
 
     gain, left = _numerical_candidates(hist, parent_sums, num_bin,
-                                       has_nan, num_allowed, cfg)
+                                       has_nan, num_allowed, cfg,
+                                       mono=mono, out_lower=out_lower,
+                                       out_upper=out_upper)
     flat = gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]

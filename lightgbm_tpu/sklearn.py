@@ -247,15 +247,17 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         y_arr = np.asarray(y).ravel()
         self.classes_ = np.unique(y_arr)
         self.n_classes_ = len(self.classes_)
-        if self.n_classes_ > 2 and not callable(self.objective):
-            self._other_params["num_class"] = self.n_classes_
-            setattr(self, "num_class", self.n_classes_)
-        else:
-            # a previous multiclass fit must not leak its class count
-            # into a binary refit
-            self._other_params.pop("num_class", None)
-            if hasattr(self, "num_class"):
-                del self.num_class
+        if not callable(self.objective):
+            if self.n_classes_ > 2:
+                self._other_params["num_class"] = self.n_classes_
+                setattr(self, "num_class", self.n_classes_)
+            else:
+                # a previous multiclass fit must not leak its class
+                # count into a binary refit (a user-supplied num_class
+                # for a CALLABLE objective is left untouched)
+                self._other_params.pop("num_class", None)
+                if hasattr(self, "num_class"):
+                    del self.num_class
         return super().fit(X, y, **kwargs)
 
     def predict_proba(self, X, raw_score: bool = False,

@@ -1,0 +1,121 @@
+"""Monotone + interaction constraints (reference:
+monotone_constraints.hpp basic mode; ColSampler interaction
+constraints)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=4000, seed=0):
+    """y depends monotonically on f0 plus a NON-monotone bump, so an
+    unconstrained model learns a non-monotone response."""
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, size=(n, 4))
+    y = (0.8 * X[:, 0]
+         - 2.0 * np.exp(-((X[:, 0] - 0.5) ** 2) / 0.05)   # dip at 0.5
+         + 0.5 * X[:, 1] + rng.normal(scale=0.1, size=n))
+    return X, y
+
+
+def _response_curve(bst, base_row, f, grid):
+    rows = np.tile(base_row, (len(grid), 1))
+    rows[:, f] = grid
+    return bst.predict(rows)
+
+
+def test_monotone_increasing_enforced():
+    X, y = _data()
+    grid = np.linspace(-2, 2, 201)
+    base = np.array([0.0, 0.0, 0.0, 0.0])
+
+    unconstrained = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=40)
+    r_un = _response_curve(unconstrained, base, 0, grid)
+    assert np.min(np.diff(r_un)) < -1e-3   # the dip is really learned
+
+    constrained = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "monotone_constraints": [1, 0, 0, 0]},
+        lgb.Dataset(X, label=y), num_boost_round=40)
+    r_c = _response_curve(constrained, base, 0, grid)
+    assert np.min(np.diff(r_c)) >= -1e-6   # non-decreasing everywhere
+    # and for several random contexts, not just the base row
+    rng = np.random.default_rng(1)
+    for _ in range(5):
+        row = rng.uniform(-2, 2, size=4)
+        r = _response_curve(constrained, row, 0, grid)
+        assert np.min(np.diff(r)) >= -1e-6
+    # the constrained model still fits the monotone part
+    assert np.corrcoef(constrained.predict(X), y)[0, 1] > 0.8
+
+
+def test_monotone_decreasing_enforced():
+    X, y = _data(seed=2)
+    y = -y
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "monotone_constraints": "-1,0,0,0"},
+        lgb.Dataset(X, label=y), num_boost_round=30)
+    grid = np.linspace(-2, 2, 101)
+    r = _response_curve(bst, np.zeros(4), 0, grid)
+    assert np.max(np.diff(r)) <= 1e-6      # non-increasing
+
+
+def _paths_features(tree):
+    """All root->leaf paths as feature sets."""
+    out = []
+
+    def walk(node, used):
+        if node < 0:
+            out.append(used)
+            return
+        u2 = used | {int(tree.split_feature[node])}
+        walk(int(tree.left_child[node]), u2)
+        walk(int(tree.right_child[node]), u2)
+
+    if tree.num_nodes:
+        walk(0, set())
+    return out
+
+
+def test_interaction_constraints_paths():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(4000, 6))
+    # y needs cross-group interactions the constraint forbids
+    y = (X[:, 0] * X[:, 2] + X[:, 1] + X[:, 4]
+         + rng.normal(scale=0.1, size=4000))
+    groups = [[0, 1], [2, 3], [4, 5]]
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "interaction_constraints": "[0,1],[2,3],[4,5]"},
+        lgb.Dataset(X, label=y), num_boost_round=15)
+    eng = bst.engine
+    used_map = eng.train_set.used_features
+    for t in eng.models:
+        for path in _paths_features(t):
+            orig = {used_map[f] for f in path}
+            assert any(orig <= set(g) for g in groups), \
+                f"path {sorted(orig)} crosses constraint groups"
+
+
+def test_interaction_constraints_list_form():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(1500, 4))
+    y = X @ rng.normal(size=4)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+         "interaction_constraints": [[0, 1], [2, 3]]},
+        lgb.Dataset(X, label=y), num_boost_round=5)
+    assert bst.num_trees() == 5
+
+
+def test_monotone_with_data_parallel():
+    X, y = _data(seed=5)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+         "monotone_constraints": [1, 0, 0, 0], "tree_learner": "data"},
+        lgb.Dataset(X, label=y), num_boost_round=20)
+    grid = np.linspace(-2, 2, 101)
+    r = _response_curve(bst, np.zeros(4), 0, grid)
+    assert np.min(np.diff(r)) >= -1e-6
