@@ -48,14 +48,15 @@ class _DeviceData:
 
     def __init__(self, ds: Dataset, rows_per_block: int, mesh=None,
                  transposed: bool = False, shard_features: bool = False,
-                 n_feature_pad: int = 0):
+                 n_feature_pad: int = 0, binned_override=None):
         ds.construct()
         self.n = ds.num_data
         # feature-parallel replicates rows; data/voting shard them
         row_shards = (mesh.devices.size
                       if mesh is not None and not shard_features else 1)
         self.n_pad = pad_rows(self.n, rows_per_block * row_shards)
-        binned = ds.binned
+        binned = (ds.binned if binned_override is None
+                  else binned_override)   # EFB physical matrix
         if n_feature_pad and binned.shape[1] < n_feature_pad:
             # pad feature columns so every device owns an equal slice
             # (scatter/feature-parallel); padded features never split
@@ -169,10 +170,48 @@ class GBDT:
 
         F = len(self.train_set.used_features)
         self.num_features = F
+
+        # ---- EFB (dataset_loader.cpp FindGroups/FastFeatureBundling) --
+        # bundle mutually-exclusive sparse features into shared physical
+        # columns; the learner scans F_phys columns and expands
+        # histograms back to logical features (io/bundling.py). Composes
+        # with serial / data-psum / voting (scatter and feature-parallel
+        # keep their own feature-ownership layouts instead).
+        self.has_bundles = False
+        self.bundle_plan = None
+        self._bundle_dev = None
+        self._bundled_binned = None
+        if config.enable_bundle and F >= 2 and not self._shard_features:
+            mappers = [self.train_set.bin_mappers[f]
+                       for f in self.train_set.used_features]
+            eligible = np.array(
+                [(m.bin_type != "categorical")
+                 and m.missing_type == "none" for m in mappers],
+                dtype=bool)
+            default_bins = np.array(
+                [m.value_to_bin(0.0) if eligible[i] else 0
+                 for i, m in enumerate(mappers)], dtype=np.int32)
+            if int(eligible.sum()) >= 2:
+                from ..io.bundling import find_bundles, plan_bundles
+                nb_logical = self.train_set.feature_num_bins()
+                multi = find_bundles(
+                    self.train_set.binned, nb_logical, eligible,
+                    default_bins,
+                    max_conflict_rate=config.max_conflict_rate,
+                    seed=config.data_random_seed)
+                if multi:
+                    self.bundle_plan = plan_bundles(nb_logical,
+                                                    default_bins, multi)
+                    self.has_bundles = True
+                    log.info(
+                        f"EFB: bundled {sum(len(b) for b in multi)} "
+                        f"features into {len(multi)} bundles "
+                        f"({F} -> {self.bundle_plan.n_phys} columns)")
+
         # pad feature count to a multiple of the shard count so scatter /
         # feature-parallel slices are equal-width (padded features carry
         # num_bin=1 + allowed=False, so they never win a split)
-        need_fpad = self.mesh is not None and (
+        need_fpad = self.mesh is not None and not self.has_bundles and (
             self._shard_features
             or (self.learner_type == "data"
                 and config.tpu_hist_reduce == "scatter"))
@@ -180,6 +219,11 @@ class GBDT:
         fpad = self.F_pad - F
         num_bin = self.train_set.feature_num_bins()
         self.max_num_bin = int(num_bin.max()) if F else 2
+        if self.has_bundles:
+            # one shared width covers both the physical scan and the
+            # logical expansion
+            self.max_num_bin = max(
+                self.max_num_bin, int(self.bundle_plan.phys_num_bin.max()))
         # static histogram width: pad to a lane-friendly multiple
         self.B = max(8, _ceil_to(self.max_num_bin, 8))
         is_cat = np.array(
@@ -228,6 +272,20 @@ class GBDT:
                         gm[gi, u] = True
             self.interaction_groups = jnp.asarray(gm)
 
+        if self.has_bundles:
+            from ..io.bundling import apply_bundles, build_expand_maps
+            self._bundled_binned = apply_bundles(self.train_set.binned,
+                                                 self.bundle_plan)
+            mpf, mpb, mvalid, mdef = build_expand_maps(
+                self.bundle_plan, num_bin[:F], self.B)
+            self._bundle_dev = (
+                jnp.asarray(mpf), jnp.asarray(mpb), jnp.asarray(mvalid),
+                jnp.asarray(mdef),
+                jnp.asarray(self.bundle_plan.bundled),
+                jnp.asarray(self.bundle_plan.phys_col),
+                jnp.asarray(self.bundle_plan.start),
+                jnp.asarray(self.bundle_plan.default_bin))
+
         # The fused Pallas kernel needs a TPU backend and int8-roundtrip
         # bin ids (B <= 256); anything else takes the XLA einsum path.
         self.use_pallas = bool(config.tpu_use_pallas and F > 0
@@ -236,7 +294,11 @@ class GBDT:
         self.data = _DeviceData(self.train_set, rows_per_block, self.mesh,
                                 transposed=self.use_pallas,
                                 shard_features=self._shard_features,
-                                n_feature_pad=self.F_pad)
+                                # the bundled matrix is NARROWER than F —
+                                # never pad it back to logical width
+                                n_feature_pad=(0 if self.has_bundles
+                                               else self.F_pad),
+                                binned_override=self._bundled_binned)
 
         self.grow_cfg = self._make_grow_cfg()
 
@@ -278,6 +340,20 @@ class GBDT:
             s0[:dd.n] += dd.init_score.reshape(dd.n, -1).astype(np.float32)
         return dd._place(s0, extra_dims=2)
 
+    def _logical_bins(self) -> jnp.ndarray:
+        """The LOGICAL binned train matrix for tree traversal (score
+        rebuilds). Under EFB the resident matrix is the bundled physical
+        one, so rebuild the logical layout on demand (rare: rollback /
+        continuation)."""
+        if not self.has_bundles:
+            return self.data.bins
+        binned = self.train_set.binned
+        if self.data.n_pad > binned.shape[0]:
+            binned = np.concatenate(
+                [binned, np.zeros((self.data.n_pad - binned.shape[0],
+                                   binned.shape[1]), binned.dtype)])
+        return self.data._place(binned, extra_dims=2)
+
     def _load_forest(self, init_forest) -> None:
         """Continuation: adopt a loaded HostModel's trees and fold their
         predictions into the training score."""
@@ -304,7 +380,7 @@ class GBDT:
         if self.models:
             stacked, class_idx = self._stack_models(0, len(self.models))
             raw, _ = forest_predict_binned(
-                stacked, self.data.bins, self.feat_num_bin,
+                stacked, self._logical_bins(), self.feat_num_bin,
                 self.feat_has_nan, class_idx, self.num_class)
             self.score = self.score + raw
 
@@ -355,7 +431,8 @@ class GBDT:
             max_cat_to_onehot=config.max_cat_to_onehot,
             min_data_per_group=config.min_data_per_group,
             hist_scatter=(self.learner_type == "data"
-                          and config.tpu_hist_reduce == "scatter"),
+                          and config.tpu_hist_reduce == "scatter"
+                          and not self.has_bundles),
             num_shards=(self.mesh.devices.size
                         if self.mesh is not None else 1),
             voting=self.learner_type == "voting",
@@ -363,6 +440,7 @@ class GBDT:
             feature_axis=(self.axis if self._shard_features else ""),
             has_monotone=self.has_monotone,
             has_interaction=self.has_interaction,
+            has_bundles=self.has_bundles,
         )
 
     # ------------------------------------------------------------------
@@ -396,7 +474,8 @@ class GBDT:
                     bins, vals, self.feat_num_bin, self.feat_has_nan,
                     allowed, gcfg, bins_t=bins_t,
                     is_cat=self.feat_is_cat, mono=self.feat_mono,
-                    groups=self.interaction_groups)
+                    groups=self.interaction_groups,
+                    bundle=self._bundle_dev)
                 # leaf_value[leaf_id] as a one-hot matmul: a per-row
                 # gather into a [L] table runs on the TPU scalar unit
                 # (~9ms/Mrow); the masked contraction is ~free on the MXU.
@@ -872,7 +951,7 @@ class GBDT:
         if self.models:
             stacked, class_idx = self._stack_models(0, len(self.models))
             raw, _ = forest_predict_binned(
-                stacked, self.data.bins, self.feat_num_bin,
+                stacked, self._logical_bins(), self.feat_num_bin,
                 self.feat_has_nan, class_idx, self.num_class)
             score = score + raw
         self.score = score

@@ -129,6 +129,7 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "is_enable_sparse": _P("bool", True, ["is_sparse", "enable_sparse",
                                           "sparse"]),
     "enable_bundle": _P("bool", True, ["is_enable_bundle", "bundle"]),
+    "max_conflict_rate": _P("float", 0.0, [], (0.0, 1.0)),
     "use_missing": _P("bool", True),
     "zero_as_missing": _P("bool", False),
     "feature_pre_filter": _P("bool", True),

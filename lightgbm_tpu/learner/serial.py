@@ -83,6 +83,11 @@ class GrowConfig:
     # interaction constraints): zero-cost when False
     has_monotone: bool = False
     has_interaction: bool = False
+    # EFB (dataset_loader.cpp FastFeatureBundling): bins is the bundled
+    # PHYSICAL matrix; histograms are expanded to logical features via
+    # the bundle maps before split finding. Mutually exclusive with
+    # hist_scatter / feature_axis (engine enforces).
+    has_bundles: bool = False
     # categorical split search (zero-cost when has_categorical=False)
     has_categorical: bool = False
     max_cat_threshold: int = 32
@@ -171,6 +176,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               is_cat: jax.Array = None,
               mono: jax.Array = None,
               groups: jax.Array = None,
+              bundle: Tuple = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -243,7 +249,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         def hist_multi(leaf_id, small_ids):
             return hist_reduce(multi_leaf_histogram_xla(
                 bins, vals, leaf_id, small_ids, num_bins=B,
-                rows_per_block=cfg.rows_per_block))
+                rows_per_block=cfg.rows_per_block,
+                precise=cfg.precise_histogram))
 
     W = cfg.cat_words
     if not cfg.has_categorical:
@@ -252,7 +259,24 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         mono = None
     if not cfg.has_interaction:
         groups = None
-    F_meta = feat_num_bin.shape[0]      # GLOBAL feature count
+    if not cfg.has_bundles:
+        bundle = None
+    F_meta = feat_num_bin.shape[0]      # GLOBAL (logical) feature count
+    if bundle is not None:
+        assert not (mode_scatter or mode_feature), \
+            "EFB composes with serial/psum/voting learners only"
+        (bmap_pf, bmap_pb, bmap_valid, bat_def, bbundled, bphys_col,
+         bstart, bdef) = bundle
+
+        def expand_hist(hists, totals):
+            """Physical [C, F_b, Bb, 3] -> logical [C, F_meta, B, 3];
+            each bundled feature's DEFAULT-bin mass is recovered as the
+            leaf-total residual (injected at its default slot)."""
+            g = hists[:, bmap_pf, bmap_pb, :]
+            g = jnp.where(bmap_valid[None, :, :, None], g, 0.0)
+            resid = totals[:, None, :] - jnp.sum(g, axis=2)  # [C, F, 3]
+            return g + (bat_def[None, :, :, None]
+                        * resid[:, :, None, :])
 
     # search-slice metadata: under scatter/feature-parallel each device
     # searches only the F_s features it owns, offset into the GLOBAL
@@ -289,14 +313,16 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             # LOCAL histograms + local totals, elect global top-2k by
             # vote count, reduce only those columns
             local_sums = jnp.sum(hists[:, 0], axis=1)        # [C, 3]
+            if bundle is not None:
+                hists = expand_hist(hists, local_sums)
             pf = jax.vmap(lambda h, s, al, lo, hi: per_feature_gains(
                 h, s, feat_num_bin, feat_has_nan, al, scfg, is_cat,
                 mono=mono, out_lower=lo, out_upper=hi))(
                 hists, local_sums, allows_g, lowers, uppers)  # [C, F]
-            k_ = min(cfg.top_k, F)
-            vk = min(2 * cfg.top_k, F)
+            k_ = min(cfg.top_k, F_meta)
+            vk = min(2 * cfg.top_k, F_meta)
             _, top_local = jax.lax.top_k(pf, k_)             # [C, k]
-            votes = jnp.zeros((C, F), jnp.float32).at[
+            votes = jnp.zeros((C, F_meta), jnp.float32).at[
                 jnp.arange(C)[:, None], top_local].add(1.0)
             votes = jax.lax.psum(votes, cfg.axis_name)
             _, elected = jax.lax.top_k(votes, vk)            # [C, vk]
@@ -316,6 +342,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             best["feature"] = jnp.take_along_axis(
                 elected, best["feature"][:, None], axis=1)[:, 0]
             return best
+        if bundle is not None:
+            hists = expand_hist(hists, sums)
         allows_s = (jax.lax.dynamic_slice_in_dim(allows_g, off, F_s,
                                                  axis=1)
                     if (mode_scatter or mode_feature) else allows_g)
@@ -447,6 +475,14 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 (bs_k & jnp.uint32(0xFFFF)).astype(jnp.float32), 1, 0))
             attr_cols.extend(jnp.moveaxis(
                 (bs_k >> jnp.uint32(16)).astype(jnp.float32), 1, 0))
+        if cfg.has_bundles:
+            # EFB: the row pass reads the PHYSICAL bundle column and
+            # recovers the logical bin via the member's offset/default
+            attr_cols.extend([
+                bphys_col[bfeat_k].astype(jnp.float32),
+                bstart[bfeat_k].astype(jnp.float32),
+                bbundled[bfeat_k].astype(jnp.float32),
+                bdef[bfeat_k].astype(jnp.float32)])
         packed = jnp.stack(attr_cols, axis=1)
         row_attr = jax.lax.dot_general(
             mask_k.astype(jnp.float32), packed,
@@ -464,13 +500,29 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         # column — its contribution is broadcast by the psum (every
         # other device contributes zeros), the TPU-native replacement
         # for the reference's full-data local split.
+        if cfg.has_bundles:
+            bidx = 6 + ((1 + 2 * W) if cfg.has_categorical else 0)
+            pcol_r = row_attr[:, bidx].astype(i32)
+            start_r = row_attr[:, bidx + 1].astype(i32)
+            bundled_r = row_attr[:, bidx + 2] > 0.5
+            def_r = row_attr[:, bidx + 3].astype(i32)
+        else:
+            pcol_r = feat_r
         col_ids = jnp.arange(F, dtype=i32)
         if mode_feature:
             col_ids = col_ids + off
-        oh_f = feat_r[:, None] == col_ids[None, :]
+        oh_f = pcol_r[:, None] == col_ids[None, :]
         col = jnp.sum(jnp.where(oh_f, bins.astype(i32), 0), axis=1)
         if mode_feature:
             col = jax.lax.psum(col, cfg.feature_axis)
+        if cfg.has_bundles:
+            # invert the bundle relabeling: phys v -> logical bin
+            # (the member's default bin was skipped in the enumeration)
+            idx = col - start_r
+            in_r = (idx >= 0) & (idx <= nb_r - 2)
+            b_log = idx + (idx >= def_r).astype(i32)
+            col = jnp.where(bundled_r,
+                            jnp.where(in_r, b_log, def_r), col)
         is_missing = hn_r & (col == nb_r - 1)
         goes_left = jnp.where(is_missing, dl_r, col <= thr_r)
         if cfg.has_categorical:

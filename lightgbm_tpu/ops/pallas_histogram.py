@@ -125,9 +125,12 @@ def multi_leaf_histogram(bins_t: jax.Array, vals_t: jax.Array,
 def multi_leaf_histogram_xla(bins: jax.Array, vals: jax.Array,
                              leaf_id: jax.Array, small_ids: jax.Array, *,
                              num_bins: int,
-                             rows_per_block: int = 1024) -> jax.Array:
+                             rows_per_block: int = 1024,
+                             precise: bool = False) -> jax.Array:
     """XLA fallback (CPU tests / non-TPU backends): same contract via the
-    einsum-based build_histogram with leaf masks packed into channels."""
+    einsum-based build_histogram with leaf masks packed into channels.
+    ``precise`` keeps grad/hess in float32 (tpu_double_precision_hist)
+    instead of the default bfloat16 operands."""
     from .histogram import build_histogram
     K = small_ids.shape[0]
     n, _F = bins.shape
@@ -135,6 +138,6 @@ def multi_leaf_histogram_xla(bins: jax.Array, vals: jax.Array,
     mask = (leaf_id[:, None] == small_ids[None, :]).astype(vals.dtype)
     packed = (mask[:, :, None] * vals[:, None, :]).reshape(n, K * C)
     hist = build_histogram(bins, packed, num_bins=num_bins,
-                           rows_per_block=rows_per_block)
+                           rows_per_block=rows_per_block, precise=precise)
     F, B, _ = hist.shape
     return hist.reshape(F, B, K, C).transpose(2, 0, 1, 3)

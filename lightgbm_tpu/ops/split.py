@@ -101,16 +101,27 @@ def _pack_bitset(inset: jax.Array, n_words: int) -> jax.Array:
 
 
 def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
-                            is_cat, cfg: SplitConfig):
+                            is_cat, cfg: SplitConfig,
+                            out_lower=None, out_upper=None):
     """Candidate categorical gains: ``(all_gain [F, 3, B], orders
     [F, 2, B], cum [F, 2, B, 3], valid_bin [F, B])`` — modes are
-    (one-hot, sorted-asc, sorted-desc)."""
+    (one-hot, sorted-asc, sorted-desc). With monotone bounds active,
+    gains are evaluated at range-clipped outputs like the numerical
+    scan, so the cat-vs-numerical comparison stays fair in bounded
+    leaves (categorical features themselves carry no direction)."""
     f, b, _ = hist.shape
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]
     cnt = hist[..., 2]
     l1, l2c = cfg.lambda_l1, cfg.lambda_l2 + cfg.cat_l2
     pg, ph, pc = parent_sums[0], parent_sums[1], parent_sums[2]
-    parent_gain = leaf_gain(pg, ph, l1, l2c)
+    bounded = cfg.has_monotone and out_lower is not None
+    if bounded:
+        p_out = jnp.clip(calc_leaf_output(pg, ph, l1, l2c,
+                                          cfg.max_delta_step),
+                         out_lower, out_upper)
+        parent_gain = leaf_gain_at_output(pg, ph, l1, l2c, p_out)
+    else:
+        parent_gain = leaf_gain(pg, ph, l1, l2c)
     min_cnt = float(max(cfg.min_data_in_leaf, cfg.min_data_per_group))
 
     cat_ok = is_cat & allowed_feature
@@ -119,8 +130,19 @@ def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
 
     def child_gain(lg, lh, lc):
         rg, rh, rc = pg - lg, ph - lh, pc - lc
-        g = (leaf_gain(lg, lh, l1, l2c) + leaf_gain(rg, rh, l1, l2c)
-             - parent_gain)
+        if bounded:
+            lo = jnp.clip(calc_leaf_output(lg, lh, l1, l2c,
+                                           cfg.max_delta_step),
+                          out_lower, out_upper)
+            ro = jnp.clip(calc_leaf_output(rg, rh, l1, l2c,
+                                           cfg.max_delta_step),
+                          out_lower, out_upper)
+            g = (leaf_gain_at_output(lg, lh, l1, l2c, lo)
+                 + leaf_gain_at_output(rg, rh, l1, l2c, ro)
+                 - parent_gain)
+        else:
+            g = (leaf_gain(lg, lh, l1, l2c) + leaf_gain(rg, rh, l1, l2c)
+                 - parent_gain)
         ok = ((lc >= min_cnt) & (rc >= min_cnt)
               & (lh >= cfg.min_sum_hessian_in_leaf)
               & (rh >= cfg.min_sum_hessian_in_leaf)
@@ -158,7 +180,7 @@ def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
 
 
 def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
-                      cfg: SplitConfig):
+                      cfg: SplitConfig, out_lower=None, out_upper=None):
     """Best categorical split (one-hot + sorted many-vs-many).
 
     Reference: ``FindBestThresholdCategoricalInner``
@@ -177,7 +199,8 @@ def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
     f, b, _ = hist.shape
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]
     all_gain, orders, cum, valid_bin = _categorical_candidates(
-        hist, parent_sums, num_bin, allowed_feature, is_cat, cfg)
+        hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
+        out_lower=out_lower, out_upper=out_upper)
     flat = all_gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -289,7 +312,8 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
     pf = jnp.max(gain, axis=(1, 2))                            # [F]
     if cfg.has_categorical and is_cat is not None:
         all_gain, _, _, _ = _categorical_candidates(
-            hist, parent_sums, num_bin, allowed_feature, is_cat, cfg)
+            hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
+            out_lower=out_lower, out_upper=out_upper)
         pf = jnp.maximum(pf, jnp.max(all_gain, axis=(1, 2)))
     return pf
 
@@ -359,7 +383,8 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
 
     if cfg.has_categorical and is_cat is not None:
         cgain, cfeat, cleft, cinset = _categorical_best(
-            hist, parent_sums, num_bin, allowed_feature, is_cat, cfg)
+            hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
+            out_lower=out_lower, out_upper=out_upper)
         take_cat = cgain > best_gain
         best_gain = jnp.maximum(best_gain, cgain)
         feature = jnp.where(take_cat, cfeat, feature)

@@ -247,17 +247,19 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         y_arr = np.asarray(y).ravel()
         self.classes_ = np.unique(y_arr)
         self.n_classes_ = len(self.classes_)
-        if not callable(self.objective):
-            if self.n_classes_ > 2:
-                self._other_params["num_class"] = self.n_classes_
-                setattr(self, "num_class", self.n_classes_)
-            else:
-                # a previous multiclass fit must not leak its class
-                # count into a binary refit (a user-supplied num_class
-                # for a CALLABLE objective is left untouched)
-                self._other_params.pop("num_class", None)
-                if hasattr(self, "num_class"):
-                    del self.num_class
+        auto = getattr(self, "_auto_num_class", False)
+        if not callable(self.objective) and self.n_classes_ > 2:
+            self._other_params["num_class"] = self.n_classes_
+            setattr(self, "num_class", self.n_classes_)
+            self._auto_num_class = True
+        elif auto:
+            # a previous fit's AUTO-set class count must not leak into a
+            # refit (binary, or custom-objective); a user-supplied
+            # num_class is left untouched
+            self._other_params.pop("num_class", None)
+            if hasattr(self, "num_class"):
+                del self.num_class
+            self._auto_num_class = False
         return super().fit(X, y, **kwargs)
 
     def predict_proba(self, X, raw_score: bool = False,

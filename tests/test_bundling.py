@@ -1,0 +1,134 @@
+"""Exclusive Feature Bundling (EFB) tests (reference:
+dataset_loader.cpp FindGroups/FastFeatureBundling semantics)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.bundling import (apply_bundles, build_expand_maps,
+                                      find_bundles, plan_bundles)
+
+
+def _onehot_data(n=3000, groups=3, cards=(8, 6, 4), seed=0):
+    """One-hot blocks: within a block exactly one column is 1 per row —
+    perfectly exclusive, the EFB sweet spot (Criteo shape)."""
+    rng = np.random.default_rng(seed)
+    cols = []
+    ids = []
+    for c in cards:
+        g = rng.integers(0, c, size=n)
+        ids.append(g)
+        block = np.zeros((n, c))
+        block[np.arange(n), g] = 1.0
+        cols.append(block)
+    dense = rng.normal(size=(n, 2))
+    X = np.column_stack(cols + [dense])
+    w = [rng.normal(size=c) for c in cards]
+    logit = sum(w[i][ids[i]] for i in range(len(cards))) + dense[:, 0]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(float)
+    return X, y
+
+
+def test_find_bundles_onehot_exclusive():
+    X, y = _onehot_data()
+    binned = (X[:, :18] != 0).astype(np.uint8)   # one-hot cols as bins
+    num_bins = np.full(18, 2)
+    eligible = np.ones(18, dtype=bool)
+    bundles = find_bundles(binned, num_bins, eligible, np.zeros(18, int))
+    # the three one-hot blocks are mutually exclusive within themselves:
+    # everything packs into few bundles with zero conflicts
+    assert len(bundles) >= 1
+    bundled_feats = {f for b in bundles for f in b}
+    assert len(bundled_feats) >= 12
+
+
+def test_bundle_roundtrip_exact():
+    rng = np.random.default_rng(1)
+    n, F = 500, 6
+    binned = np.zeros((n, F), dtype=np.uint8)
+    # exclusive pattern: each row has at most one non-zero among 0..3
+    which = rng.integers(0, 5, size=n)          # 4 == none
+    for f in range(4):
+        rows = which == f
+        binned[rows, f] = rng.integers(1, 4, size=int(rows.sum()))
+    binned[:, 4] = rng.integers(0, 4, size=n)   # dense, not bundled
+    binned[:, 5] = rng.integers(0, 4, size=n)
+    num_bins = np.full(F, 4)
+    defaults = np.zeros(F, dtype=int)
+    bundles = find_bundles(binned, num_bins, np.ones(F, bool), defaults)
+    assert bundles and set(bundles[0]) <= {0, 1, 2, 3}
+    plan = plan_bundles(num_bins, defaults, bundles)
+    phys = apply_bundles(binned, plan)
+    assert phys.shape[1] == plan.n_phys < F
+    # recover every logical bin from the physical matrix
+    for f in range(F):
+        col = phys[:, plan.phys_col[f]].astype(int)
+        if plan.bundled[f]:
+            d = plan.default_bin[f]
+            idx = col - plan.start[f]
+            in_r = (idx >= 0) & (idx <= num_bins[f] - 2)
+            rec = np.where(in_r, idx + (idx >= d), d)
+        else:
+            rec = col
+        np.testing.assert_array_equal(rec, binned[:, f])
+
+
+def test_bundled_training_equals_unbundled():
+    """The oracle: with zero conflicts EFB must produce the same model
+    as unbundled training. Precise (f32) histograms isolate the bundling
+    semantics from the default bf16 accumulation, whose error the two
+    layouts distribute differently."""
+    X, y = _onehot_data()
+    preds = {}
+    for enable in (True, False):
+        bst = lgb.train(
+            {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+             "enable_bundle": enable, "min_data_in_leaf": 5,
+             "tpu_double_precision_hist": True},
+            lgb.Dataset(X, label=y), num_boost_round=10)
+        if enable:
+            assert bst.engine.has_bundles, "EFB should trigger here"
+            assert bst.engine.bundle_plan.n_phys < 20
+        preds[enable] = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(preds[True], preds[False],
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_bundled_model_text_and_holdout():
+    X, y = _onehot_data(seed=3)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 5},
+        lgb.Dataset(X[:2400], label=y[:2400]), num_boost_round=10)
+    s = bst.model_to_string()
+    p1 = bst.predict(X[2400:])
+    p2 = lgb.Booster(model_str=s).predict(X[2400:])
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_bundling_with_valid_and_data_parallel():
+    X, y = _onehot_data(seed=4)
+    ds = lgb.Dataset(X[:2400], label=y[:2400])
+    vs = ds.create_valid(X[2400:], label=y[2400:])
+    res = {}
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "tree_learner": "data", "metric": "auc", "min_data_in_leaf": 5},
+        ds, num_boost_round=15, valid_sets=[vs],
+        callbacks=[lgb.record_evaluation(res)])
+    assert bst.engine.has_bundles
+    assert res["valid_0"]["auc"][-1] > 0.85
+
+
+def test_rollback_with_bundles():
+    """Score rebuild must use the LOGICAL matrix, not the bundled one."""
+    X, y = _onehot_data(seed=5, n=1200)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    ds, num_boost_round=5)
+    eng = bst.engine
+    assert eng.has_bundles
+    score5 = np.asarray(eng.score)[:eng.data.n, 0].copy()
+    eng.train_one_iter()
+    eng.rollback_one_iter()
+    score5b = np.asarray(eng.score)[:eng.data.n, 0]
+    np.testing.assert_allclose(score5, score5b, rtol=1e-4, atol=1e-4)
