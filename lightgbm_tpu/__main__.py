@@ -1,0 +1,5 @@
+"""``python -m lightgbm_tpu config=train.conf`` — the CLI entry point
+(reference: src/main.cpp lightgbm executable)."""
+from .app import main
+
+main()

@@ -1,0 +1,129 @@
+"""CLI application: config-file-driven train / predict.
+
+Reference: ``Application`` (src/application/application.cpp, src/main.cpp,
+UNVERIFIED — empty mount, see SURVEY.md banner): parse ``key=value`` args
+(first positional = config file), dispatch on ``task``:
+
+- ``task=train``: load data/valid files, train, save ``output_model``
+  (+ ``snapshot_freq`` checkpoints handled by engine.train)
+- ``task=predict``: load ``input_model``, predict ``data``, write
+  ``output_result``
+- ``task=convert_model``: load + re-save a model (format passthrough)
+- ``task=save_binary``: bin the data file and write the binary dataset
+
+Invoke as ``python -m lightgbm_tpu config=train.conf`` or with inline
+``key=value`` pairs.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import parse_config_file
+from .engine import train
+from .utils import log
+
+
+def parse_cli_args(argv: List[str]) -> Dict[str, Any]:
+    """key=value args; ``config=FILE`` pulls in a reference-style config
+    file (k=v lines, '#' comments); CLI pairs override the file."""
+    cli: Dict[str, Any] = {}
+    config_path = None
+    for tok in argv:
+        if "=" not in tok:
+            config_path = tok          # bare positional = config file
+            continue
+        k, _, v = tok.partition("=")
+        if k.strip() == "config":
+            config_path = v.strip()
+        else:
+            cli[k.strip()] = v.strip()
+    params: Dict[str, Any] = {}
+    if config_path:
+        params.update(parse_config_file(config_path))
+    params.update(cli)
+    return params
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    params = parse_cli_args(list(sys.argv[1:] if argv is None else argv))
+    task = str(params.pop("task", "train")).lower()
+    data_path = params.pop("data", None)
+    valid_spec = params.pop("valid", params.pop("valid_data", None))
+    output_model = params.get("output_model", "LightGBM_model.txt")
+    input_model = params.pop("input_model", None)
+    output_result = params.pop("output_result", "LightGBM_predict_result.txt")
+    num_round = int(params.pop("num_iterations",
+                               params.pop("num_boost_round", 100)))
+
+    if task in ("train", "refit"):
+        if data_path is None:
+            log.fatal("No training data: pass data=FILE")
+        ds = Dataset(data_path, params=dict(params))
+        valid_sets, valid_names = [], []
+        if valid_spec:
+            for i, vp in enumerate(str(valid_spec).split(",")):
+                valid_sets.append(Dataset(vp, reference=ds,
+                                          params=dict(params)))
+                valid_names.append(vp)
+        params.setdefault("verbosity", 1)
+        bst = train(params, ds, num_boost_round=num_round,
+                    valid_sets=valid_sets or None,
+                    valid_names=valid_names or None,
+                    init_model=input_model)
+        bst.save_model(output_model)
+        log.info(f"Finished training; model saved to {output_model}")
+        return 0
+
+    if task in ("predict", "prediction", "test"):
+        if input_model is None:
+            log.fatal("task=predict needs input_model=FILE")
+        if data_path is None:
+            log.fatal("No data to predict: pass data=FILE")
+        bst = Booster(model_file=input_model)
+        from .config import coerce_bool
+        from .io.text_loader import load_text
+        loaded = load_text(data_path,
+                           label_column=params.get("label_column", "auto"))
+        X = loaded.X
+        n_feat = bst.num_feature()
+        if X.shape[1] < n_feat:
+            # libsvm files size by max PRESENT index; pad to the model's
+            # feature count (the reference pads parsed rows the same way)
+            X = np.concatenate(
+                [X, np.zeros((len(X), n_feat - X.shape[1]))], axis=1)
+        pred = bst.predict(
+            X,
+            raw_score=coerce_bool(params.get("predict_raw_score", False)),
+            pred_leaf=coerce_bool(params.get("predict_leaf_index", False)),
+            pred_contrib=coerce_bool(params.get("predict_contrib",
+                                                False)))
+        np.savetxt(output_result, np.atleast_1d(pred), fmt="%.10g",
+                   delimiter="\t")
+        log.info(f"Finished prediction; results saved to {output_result}")
+        return 0
+
+    if task == "convert_model":
+        if input_model is None:
+            log.fatal("task=convert_model needs input_model=FILE")
+        Booster(model_file=input_model).save_model(
+            params.get("convert_model", "model_out.txt"))
+        return 0
+
+    if task == "save_binary":
+        if data_path is None:
+            log.fatal("task=save_binary needs data=FILE")
+        out = params.pop("output_data", data_path + ".bin")
+        Dataset(data_path, params=dict(params)).save_binary(out)
+        log.info(f"Binary dataset saved to {out}")
+        return 0
+
+    log.fatal(f"Unknown task {task}")
+    return 1
+
+
+def main() -> None:
+    sys.exit(run())

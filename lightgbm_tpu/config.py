@@ -434,6 +434,17 @@ class Config:
         return list(_PARAMS)
 
 
+def coerce_bool(value: Any) -> bool:
+    """Public string-aware bool coercion ('false'/'0'/'off' are False)."""
+    return _coerce("<bool>", "bool", value)
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a reference-style config FILE (k=v lines, '#' comments)."""
+    with open(path) as f:
+        return parse_config_str(f.read())
+
+
 def parse_config_str(text: str) -> Dict[str, str]:
     """Parse CLI-style ``key=value`` lines (config file format)."""
     out: Dict[str, str] = {}

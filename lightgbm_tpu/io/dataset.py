@@ -86,6 +86,9 @@ class Dataset:
         self.num_total_features = 0
         self.num_data = 0
         self._raw_for_linear: Optional[np.ndarray] = None
+        import os as _os
+        if isinstance(data, (str, _os.PathLike)):
+            self._init_from_file(_os.fspath(data))
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -247,6 +250,68 @@ class Dataset:
 
     def get_init_score(self):
         return self.metadata.init_score
+
+    def _init_from_file(self, path: str) -> None:
+        """Load from disk: the framework's binary dataset format
+        (save_binary) or CSV/TSV/LibSVM text (DatasetLoader::LoadFromFile
+        semantics — label/weight/group columns + sidecar files)."""
+        import pickle
+        with open(path, "rb") as f:
+            magic = f.read(8)
+        if magic == b"LGBTBIN1":
+            with open(path, "rb") as f:
+                f.read(8)
+                state = pickle.load(f)
+            user_md = self.metadata
+            user_params = self.params
+            for k, v in state.items():
+                setattr(self, k, v)
+            # user-passed metadata/params override the stored copies
+            for field in ("label", "weight", "init_score",
+                          "query_boundaries"):
+                v = getattr(user_md, field)
+                if v is not None:
+                    setattr(self.metadata, field, v)
+            self.params = {**self.params, **user_params}
+            self._constructed = True
+            self.data = None
+            return
+        from ..config import coerce_bool
+        from .text_loader import load_text
+        p = self.params
+        loaded = load_text(
+            path,
+            label_column=p.get("label_column", "auto"),
+            weight_column=p.get("weight_column"),
+            group_column=p.get("group_column"),
+            ignore_column=p.get("ignore_column"),
+            has_header=(coerce_bool(p["header"]) if "header" in p
+                        else None))
+        self.data = loaded.X
+        if self.metadata.label is None and loaded.label is not None:
+            self.metadata.label = loaded.label.astype(np.float64)
+        if self.metadata.weight is None and loaded.weight is not None:
+            self.metadata.weight = loaded.weight.astype(np.float64)
+        if self.metadata.query_boundaries is None \
+                and loaded.group is not None:
+            self.metadata.set_group(loaded.group)
+        if self.feature_name == "auto" and loaded.feature_names:
+            self.feature_name = loaded.feature_names
+
+    def save_binary(self, path: str) -> "Dataset":
+        """Serialize the CONSTRUCTED dataset (binned matrix + mappers +
+        metadata) — the reference's binary dataset file
+        (dataset.cpp SaveBinaryFile), loadable via Dataset(path)."""
+        import pickle
+        self.construct()
+        state = {k: getattr(self, k) for k in (
+            "binned", "bin_mappers", "used_features", "feature_names",
+            "categorical_idx", "num_total_features", "num_data",
+            "metadata", "params")}
+        with open(path, "wb") as f:
+            f.write(b"LGBTBIN1")
+            pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return self
 
     def num_feature(self) -> int:
         self.construct()

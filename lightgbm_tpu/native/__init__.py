@@ -1,0 +1,73 @@
+"""Native runtime pieces: on-demand-compiled C++ loaded via ctypes.
+
+The image has g++ but no pybind11, so native components use the C ABI +
+ctypes (the reference's analog is its C API boundary, c_api.cpp). Shared
+objects are compiled once per source hash into a cache dir; every native
+entry point has a pure-Python fallback so a missing toolchain degrades
+gracefully.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_CACHED: dict = {}
+
+
+def _source_path(name: str) -> str:
+    return os.path.join(os.path.dirname(__file__), name)
+
+
+def load_native(name: str = "text_parser.cpp") -> Optional[ctypes.CDLL]:
+    """Compile (cached) + dlopen a native source; None if unavailable."""
+    if name in _CACHED:
+        return _CACHED[name]
+    lib = None
+    try:
+        src = _source_path(name)
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        cache_dir = os.path.join(tempfile.gettempdir(),
+                                 "lightgbm_tpu_native")
+        os.makedirs(cache_dir, exist_ok=True)
+        so = os.path.join(cache_dir,
+                          f"{os.path.splitext(name)[0]}_{digest}.so")
+        if not os.path.exists(so):
+            tmp = so + f".build{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-o", tmp, src],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+    except Exception:       # no g++ / sandboxed tmp / bad toolchain
+        lib = None
+    _CACHED[name] = lib
+    return lib
+
+
+def text_parser() -> Optional[ctypes.CDLL]:
+    lib = load_native("text_parser.cpp")
+    if lib is None:
+        return None
+    if not getattr(lib, "_sigs_set", False):
+        c = ctypes
+        lib.count_lines.restype = c.c_long
+        lib.count_lines.argtypes = [c.c_char_p]
+        lib.count_fields.restype = c.c_int
+        lib.count_fields.argtypes = [c.c_char_p, c.c_char]
+        lib.parse_dense.restype = c.c_long
+        lib.parse_dense.argtypes = [
+            c.c_char_p, c.c_char, c.c_int,
+            c.POINTER(c.c_double), c.c_long, c.c_int]
+        lib.parse_libsvm.restype = c.c_long
+        lib.parse_libsvm.argtypes = [
+            c.c_char_p, c.c_int, c.POINTER(c.c_int), c.POINTER(c.c_int),
+            c.POINTER(c.c_double), c.POINTER(c.c_double), c.c_long,
+            c.c_long]
+        lib._sigs_set = True
+    return lib
