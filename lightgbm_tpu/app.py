@@ -86,8 +86,14 @@ def run(argv: Optional[List[str]] = None) -> int:
         bst = Booster(model_file=input_model)
         from .config import coerce_bool
         from .io.text_loader import load_text
-        loaded = load_text(data_path,
-                           label_column=params.get("label_column", "auto"))
+        # the SAME column layout as training: weight/group/ignore columns
+        # must be dropped from X or every feature shifts
+        loaded = load_text(
+            data_path,
+            label_column=params.get("label_column", "auto"),
+            weight_column=params.get("weight_column"),
+            group_column=params.get("group_column"),
+            ignore_column=params.get("ignore_column"))
         X = loaded.X
         n_feat = bst.num_feature()
         if X.shape[1] < n_feat:

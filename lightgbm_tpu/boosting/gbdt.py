@@ -461,21 +461,87 @@ class GBDT:
                 return obj.get_gradients(s, label, weight, key=key)
             return obj.get_gradients(s, label, weight)
 
+        # gradient quantization (use_quantized_grad; reference:
+        # cuda_gradient_discretizer.cu): grad/hess become small integer
+        # levels — EXACT in the bf16 histogram matmul and int-valued on
+        # the reduction wire — with stochastic rounding for unbiasedness
+        use_quant = bool(self.config.use_quantized_grad)
+        qbins = max(2, int(self.config.num_grad_quant_bins))
+        renew_quant = bool(self.config.quant_train_renew_leaf)
+        glevels = max(qbins // 2, 1)
+        hlevels = max(qbins - 1, 1)
+
+        def quantize(gk_m, hk_m, mask_count, qkey):
+            gmax = jnp.max(jnp.abs(gk_m))
+            hmax = jnp.max(hk_m)
+            if gcfg.axis_name:
+                gmax = jax.lax.pmax(gmax, gcfg.axis_name)
+                hmax = jax.lax.pmax(hmax, gcfg.axis_name)
+            scale_g = jnp.maximum(gmax / glevels, 1e-30)
+            scale_h = jnp.maximum(hmax / hlevels, 1e-30)
+            if qkey is not None:
+                kg, kh = jax.random.split(qkey)
+                ng = jax.random.uniform(kg, gk_m.shape,
+                                        minval=-0.5, maxval=0.5)
+                nh = jax.random.uniform(kh, hk_m.shape,
+                                        minval=-0.5, maxval=0.5)
+            else:
+                ng = nh = 0.0
+            gq = jnp.round(gk_m / scale_g + ng)
+            hq = jnp.round(hk_m / scale_h + nh)
+            # stochastic rounding must not resurrect masked-out rows
+            live = mask_count > 0
+            gq = jnp.where(live, gq, 0.0)
+            hq = jnp.where(live, hq, 0.0)
+            scale = jnp.stack([scale_g, scale_h,
+                               jnp.asarray(1.0, jnp.float32)])
+            return gq, hq, scale
+
         def grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                     allowed):
+                     allowed, qkey=None):
             trees, leaf_ids = [], []
             new_score = score
             for k in range(K):
                 gk = g if K == 1 else g[:, k]
                 hk = h if K == 1 else h[:, k]
-                vals = jnp.stack(
-                    [gk * mask_gh, hk * mask_gh, mask_count], axis=1)
+                gk_m = gk * mask_gh
+                hk_m = hk * mask_gh
+                chan_scale = None
+                if use_quant:
+                    kq = (None if qkey is None
+                          else jax.random.fold_in(qkey, k))
+                    gk_q, hk_q, chan_scale = quantize(
+                        gk_m, hk_m, mask_count, kq)
+                    vals = jnp.stack([gk_q, hk_q, mask_count], axis=1)
+                else:
+                    vals = jnp.stack([gk_m, hk_m, mask_count], axis=1)
                 tree, leaf_id = grow_tree(
                     bins, vals, self.feat_num_bin, self.feat_has_nan,
                     allowed, gcfg, bins_t=bins_t,
                     is_cat=self.feat_is_cat, mono=self.feat_mono,
                     groups=self.interaction_groups,
-                    bundle=self._bundle_dev)
+                    bundle=self._bundle_dev, chan_scale=chan_scale)
+                if use_quant and renew_quant:
+                    # re-derive leaf outputs from FULL-precision sums
+                    # (quant_train_renew_leaf)
+                    from ..ops.split import calc_leaf_output
+                    Lq = tree["leaf_value"].shape[0]
+                    oh = (leaf_id[:, None]
+                          == jnp.arange(Lq, dtype=jnp.int32)[None, :])
+                    sums = jax.lax.dot_general(
+                        oh.astype(jnp.float32),
+                        jnp.stack([gk_m, hk_m], axis=1),
+                        dimension_numbers=(((0,), (0,)), ((), ())),
+                        precision=jax.lax.Precision.HIGHEST)   # [L, 2]
+                    if gcfg.axis_name:
+                        sums = jax.lax.psum(sums, gcfg.axis_name)
+                    renewed = calc_leaf_output(
+                        sums[:, 0], sums[:, 1], gcfg.lambda_l1,
+                        gcfg.lambda_l2, gcfg.max_delta_step)
+                    tree = dict(tree)
+                    tree["leaf_value"] = jnp.where(
+                        tree["leaf_count"] > 0, renewed,
+                        tree["leaf_value"])
                 # leaf_value[leaf_id] as a one-hot matmul: a per-row
                 # gather into a [L] table runs on the TPU scalar unit
                 # (~9ms/Mrow); the masked contraction is ~free on the MXU.
@@ -502,7 +568,8 @@ class GBDT:
                       mask_count, allowed, key):
             g, h = gradients(score, label, weight, key)
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                            allowed)
+                            allowed,
+                            qkey=jax.random.fold_in(key, 0x9e37))
 
         top_rate = float(self.config.top_rate)
         other_rate = float(self.config.other_rate)
@@ -539,12 +606,12 @@ class GBDT:
             g, h = gradients(score, label, weight, kg)
             mask_gh, mask_count = goss_masks(g, h, valid_mask, km)
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                            allowed)
+                            allowed, qkey=jax.random.fold_in(key, 0x9e37))
 
         def step_custom_impl(bins, bins_t, score, g, h, mask_gh,
-                             mask_count, allowed):
+                             mask_count, allowed, key):
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                            allowed)
+                            allowed, qkey=key)
 
         def valid_update_impl(valid_bins_scores, stacked_trees):
             # apply this iteration's K trees to each valid set's raw scores
@@ -580,9 +647,10 @@ class GBDT:
                                       score, d.valid_mask, allowed, key)
 
             @jax.jit
-            def step_custom(score, g, h, mask_gh, mask_count, allowed):
+            def step_custom(score, g, h, mask_gh, mask_count, allowed,
+                            key):
                 return step_custom_impl(d.bins, d.bins_t, score, g, h,
-                                        mask_gh, mask_count, allowed)
+                                        mask_gh, mask_count, allowed, key)
 
             valid_update = plain_valid_update
         else:
@@ -634,7 +702,7 @@ class GBDT:
             sharded_custom = shard_map(
                 step_custom_impl, mesh=mesh,
                 in_specs=(bins_spec, bt_spec, row2, grad_spec, grad_spec,
-                          row1, row1, rep),
+                          row1, row1, rep, rep),
                 out_specs=out_specs, check_vma=False)
 
             @jax.jit
@@ -649,9 +717,10 @@ class GBDT:
                                     score, d.valid_mask, allowed, key)
 
             @jax.jit
-            def step_custom(score, g, h, mask_gh, mask_count, allowed):
+            def step_custom(score, g, h, mask_gh, mask_count, allowed,
+                            key):
                 return sharded_custom(d.bins, d.bins_t, score, g, h,
-                                      mask_gh, mask_count, allowed)
+                                      mask_gh, mask_count, allowed, key)
 
             if self._shard_features:
                 # feature-parallel valid sets are replicated (prediction
@@ -795,7 +864,7 @@ class GBDT:
             g = self._pad_custom(grad)
             h = self._pad_custom(hess)
             stacked, leaf_ids, new_score = self._step_custom(
-                self.score, g, h, mask_gh, mask_count, allowed)
+                self.score, g, h, mask_gh, mask_count, allowed, key)
         elif goss_active:
             stacked, leaf_ids, new_score = self._step_goss(
                 self.score, allowed, key)

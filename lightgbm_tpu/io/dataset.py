@@ -155,14 +155,16 @@ class Dataset:
             self.feature_names = ref.feature_names
             self.categorical_idx = ref.categorical_idx
         else:
+            from ..config import coerce_bool
             p = self.params
             self.bin_mappers = find_bin_mappers(
                 X,
                 max_bin=int(p.get("max_bin", 255)),
                 min_data_in_bin=int(p.get("min_data_in_bin", 3)),
                 sample_cnt=int(p.get("bin_construct_sample_cnt", 200000)),
-                use_missing=bool(p.get("use_missing", True)),
-                zero_as_missing=bool(p.get("zero_as_missing", False)),
+                use_missing=coerce_bool(p.get("use_missing", True)),
+                zero_as_missing=coerce_bool(p.get("zero_as_missing",
+                                                  False)),
                 categorical_features=cat_idx,
                 max_bin_by_feature=p.get("max_bin_by_feature"),
                 seed=int(p.get("data_random_seed", 1)))
@@ -184,7 +186,8 @@ class Dataset:
                         .astype(dtype))
         self.binned = (np.stack(cols, axis=1) if cols
                        else np.zeros((self.num_data, 0), dtype=dtype))
-        if bool(self.params.get("linear_tree", False)):
+        from ..config import coerce_bool as _cb
+        if _cb(self.params.get("linear_tree", False)):
             self._raw_for_linear = X[:, self.used_features].copy()
         self._constructed = True
         if self.free_raw_data:
@@ -276,9 +279,11 @@ class Dataset:
             self._constructed = True
             self.data = None
             return
-        from ..config import coerce_bool
+        from ..config import Config, coerce_bool
         from .text_loader import load_text
-        p = self.params
+        # resolve reference aliases (label=, weight=, group=/query=,
+        # has_header=, ignore_feature=...) to canonical names
+        p = {Config.canonical_name(k): v for k, v in self.params.items()}
         loaded = load_text(
             path,
             label_column=p.get("label_column", "auto"),

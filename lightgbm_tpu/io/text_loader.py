@@ -193,20 +193,31 @@ def load_text(path, label_column="auto", weight_column=None,
         X = None
         if lib is not None:
             n_rows = lib.count_lines(path.encode()) - (1 if header else 0)
-            n_cols = lib.count_fields(path.encode(), delim.encode())
+            # field count from the already-read first line (avoids a
+            # second full-file pass in the native counter)
+            n_cols = _first_data_lines(path, 1)[0].count(delim) + 1
             if n_rows > 0 and n_cols > 0:
                 X = _parse_dense_native(path, delim, 1 if header else 0,
                                         n_rows, n_cols)
         if X is None:
             X = _parse_dense_python(path, delim, 1 if header else 0)
         lbl_idx = (_resolve_column(
-            0 if label_column == "auto" else label_column, names))
+            0 if label_column in ("auto", "", None) else label_column,
+            names))
         w_idx = _resolve_column(weight_column, names)
         g_idx = _resolve_column(group_column, names)
         drop = [i for i in (lbl_idx, w_idx, g_idx) if i is not None]
         if ignore_column:
-            spec = (ignore_column.split(",")
-                    if isinstance(ignore_column, str) else ignore_column)
+            if isinstance(ignore_column, str):
+                s = ignore_column
+                if s.startswith("name:"):
+                    # reference form name:c1,c2,c3 — prefix applies to
+                    # the whole comma list
+                    spec = ["name:" + c for c in s[5:].split(",") if c]
+                else:
+                    spec = s.split(",")
+            else:
+                spec = ignore_column
             drop += [_resolve_column(c, names) for c in spec]
         keep = [i for i in range(X.shape[1]) if i not in drop]
         out = LoadedText(

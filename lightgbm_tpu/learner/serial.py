@@ -177,6 +177,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               mono: jax.Array = None,
               groups: jax.Array = None,
               bundle: Tuple = None,
+              chan_scale: jax.Array = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -213,17 +214,25 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         F_s = F
 
     def hist_reduce(h):
-        """Mode-specific cross-device histogram reduction."""
+        """Mode-specific cross-device histogram reduction. With
+        quantized gradients (use_quantized_grad), ``vals`` hold small
+        integer levels — EXACT in the bf16 matmul and reduced as ints
+        (the reference's int-histogram allreduce,
+        cuda_gradient_discretizer.cu) — and are rescaled to real units
+        here, right after the reduction."""
         if mode_scatter:
             # the reference's ReduceScatter: each device receives the
             # summed histograms of the features it owns
-            return jax.lax.psum_scatter(h, cfg.axis_name,
-                                        scatter_dimension=1, tiled=True)
-        if mode_voting or mode_feature or not cfg.axis_name:
-            # voting reduces only elected columns later; feature-parallel
-            # and serial histograms are already complete
-            return h
-        return jax.lax.psum(h, cfg.axis_name)
+            h = jax.lax.psum_scatter(h, cfg.axis_name,
+                                     scatter_dimension=1, tiled=True)
+        elif cfg.axis_name and not (mode_voting or mode_feature):
+            h = jax.lax.psum(h, cfg.axis_name)
+        # voting reduces only elected columns later (also in quantized
+        # units — scaling is linear so rescaling here stays correct);
+        # feature-parallel/serial histograms are already complete
+        if chan_scale is not None:
+            h = h * chan_scale
+        return h
 
     if cfg.use_pallas:
         if bins_t is None:
@@ -372,6 +381,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     root_sums = jnp.sum(vals, axis=0)
     if cfg.axis_name:
         root_sums = jax.lax.psum(root_sums, cfg.axis_name)
+    if chan_scale is not None:
+        root_sums = root_sums * chan_scale
     if cfg.has_interaction:
         # features in no constraint group can never be used
         root_allow = jnp.any(groups, axis=0) & allowed_feature  # [F_meta]
