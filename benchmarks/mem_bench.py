@@ -1,0 +1,20 @@
+import sys, time, numpy as np, jax
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+
+F, n, mode = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n, F)).astype(np.float64)
+y = (X @ rng.normal(size=F) > 0).astype(np.float64)
+cfg = Config({"objective": "binary", "num_leaves": 127, "max_bin": 255,
+              "verbosity": -1, "tpu_hist_mode": mode})
+t0 = time.time()
+eng = GBDT(cfg, lgb.Dataset(X, label=y))
+eng.train_chunk(4); jax.block_until_ready(eng.score)
+t_compile = time.time() - t0
+t0 = time.time(); eng.train_chunk(8); jax.block_until_ready(eng.score)
+dt = time.time() - t0
+stats = jax.local_devices()[0].memory_stats() or {}
+peak = stats.get("peak_bytes_in_use", 0) / 1e6
+print(f"RESULT F={F} n={n} mode={mode}: {8/dt:.2f} iters/s  peak_hbm={peak:.0f}MB  warm+compile={t_compile:.0f}s", flush=True)

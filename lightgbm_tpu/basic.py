@@ -151,16 +151,19 @@ class Booster:
         if num_iteration is None:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)
+        es_kwargs = {k: _kwargs[k] for k in
+                     ("pred_early_stop", "pred_early_stop_freq",
+                      "pred_early_stop_margin") if k in _kwargs}
         if self._from_model is not None:
             return self._from_model.predict(
                 data, raw_score=raw_score, start_iteration=start_iteration,
                 num_iteration=num_iteration, pred_leaf=pred_leaf,
-                pred_contrib=pred_contrib)
-        if pred_contrib:
-            from .io.model_text import HostModel
+                pred_contrib=pred_contrib, **es_kwargs)
+        if pred_contrib or es_kwargs.get("pred_early_stop"):
             return self._to_host_model().predict(
                 data, raw_score=raw_score, start_iteration=start_iteration,
-                num_iteration=num_iteration, pred_contrib=True)
+                num_iteration=num_iteration, pred_leaf=pred_leaf,
+                pred_contrib=pred_contrib, **es_kwargs)
         return self.engine.predict(
             data, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration or -1, pred_leaf=pred_leaf)
@@ -170,6 +173,22 @@ class Booster:
         from .io.model_text import HostModel
         return HostModel.from_engine(self.engine, self.config,
                                      best_iteration=self.best_iteration)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> Dict:
+        """JSON-able model dict (GBDT::DumpModel semantics)."""
+        from .io.model_text import dump_model_json
+        hm = (self._from_model if self._from_model is not None
+              else self._to_host_model())
+        return dump_model_json(hm, num_iteration or -1, start_iteration)
+
+    def model_to_c(self) -> str:
+        """Standalone C prediction source (convert_model if-else)."""
+        from .io.model_text import model_to_c
+        hm = (self._from_model if self._from_model is not None
+              else self._to_host_model())
+        return model_to_c(hm)
 
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0,

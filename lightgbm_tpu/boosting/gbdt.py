@@ -286,6 +286,27 @@ class GBDT:
                 jnp.asarray(self.bundle_plan.start),
                 jnp.asarray(self.bundle_plan.default_bin))
 
+        # CEGB (cost_effective_gradient_boosting.hpp): split penalty +
+        # coupled per-feature penalty charged until a feature first
+        # enters the model (host-tracked, device array refreshed on use)
+        coupled = list(config.cegb_penalty_feature_coupled or [])
+        if any(config.cegb_penalty_feature_lazy or []):
+            log.warning("cegb_penalty_feature_lazy is not implemented "
+                        "(per-row feature-acquisition tracking); use "
+                        "cegb_penalty_feature_coupled")
+        self.has_cegb = bool(
+            config.cegb_penalty_split > 0 or any(coupled))
+        self._cegb_coupled = None
+        self._cegb_used = None
+        self._cegb_pen_cache = None
+        if self.has_cegb and coupled:
+            arr = np.zeros(self.F_pad, dtype=np.float32)
+            for i, f in enumerate(self.train_set.used_features):
+                if f < len(coupled):
+                    arr[i] = float(coupled[f])
+            self._cegb_coupled = arr * float(config.cegb_tradeoff)
+            self._cegb_used = np.zeros(self.F_pad, dtype=bool)
+
         # The fused Pallas kernel needs a TPU backend and int8-roundtrip
         # bin ids (B <= 256); anything else takes the XLA einsum path.
         self.use_pallas = bool(config.tpu_use_pallas and F > 0
@@ -441,6 +462,11 @@ class GBDT:
             has_monotone=self.has_monotone,
             has_interaction=self.has_interaction,
             has_bundles=self.has_bundles,
+            hist_rebuild=(config.tpu_hist_mode == "rebuild"),
+            feature_fraction_bynode=config.feature_fraction_bynode,
+            has_cegb=self.has_cegb,
+            cegb_tradeoff=config.cegb_tradeoff,
+            cegb_penalty_split=config.cegb_penalty_split,
         )
 
     # ------------------------------------------------------------------
@@ -498,7 +524,7 @@ class GBDT:
             return gq, hq, scale
 
         def grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                     allowed, qkey=None):
+                     allowed, qkey=None, cegb_pen=None):
             trees, leaf_ids = [], []
             new_score = score
             for k in range(K):
@@ -520,7 +546,10 @@ class GBDT:
                     allowed, gcfg, bins_t=bins_t,
                     is_cat=self.feat_is_cat, mono=self.feat_mono,
                     groups=self.interaction_groups,
-                    bundle=self._bundle_dev, chan_scale=chan_scale)
+                    bundle=self._bundle_dev, chan_scale=chan_scale,
+                    node_key=(None if qkey is None
+                              else jax.random.fold_in(qkey, 0xB14D + k)),
+                    cegb_pen=cegb_pen)
                 if use_quant and renew_quant:
                     # re-derive leaf outputs from FULL-precision sums
                     # (quant_train_renew_leaf)
@@ -565,11 +594,11 @@ class GBDT:
             return stacked, jnp.stack(leaf_ids), new_score
 
         def step_impl(bins, bins_t, label, weight, score, mask_gh,
-                      mask_count, allowed, key):
+                      mask_count, allowed, cegb_pen, key):
             g, h = gradients(score, label, weight, key)
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                            allowed,
-                            qkey=jax.random.fold_in(key, 0x9e37))
+                            allowed, qkey=jax.random.fold_in(key, 0x9e37),
+                            cegb_pen=cegb_pen)
 
         top_rate = float(self.config.top_rate)
         other_rate = float(self.config.other_rate)
@@ -601,17 +630,18 @@ class GBDT:
             return mask_gh, mask_count
 
         def step_goss_impl(bins, bins_t, label, weight, score, valid_mask,
-                           allowed, key):
+                           allowed, cegb_pen, key):
             kg, km = jax.random.split(key)
             g, h = gradients(score, label, weight, kg)
             mask_gh, mask_count = goss_masks(g, h, valid_mask, km)
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                            allowed, qkey=jax.random.fold_in(key, 0x9e37))
+                            allowed, qkey=jax.random.fold_in(key, 0x9e37),
+                            cegb_pen=cegb_pen)
 
         def step_custom_impl(bins, bins_t, score, g, h, mask_gh,
-                             mask_count, allowed, key):
+                             mask_count, allowed, cegb_pen, key):
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                            allowed, qkey=key)
+                            allowed, qkey=key, cegb_pen=cegb_pen)
 
         def valid_update_impl(valid_bins_scores, stacked_trees):
             # apply this iteration's K trees to each valid set's raw scores
@@ -637,20 +667,23 @@ class GBDT:
             d = self.data
 
             @jax.jit
-            def step(score, mask_gh, mask_count, allowed, key):
+            def step(score, mask_gh, mask_count, allowed, cegb_pen, key):
                 return step_impl(d.bins, d.bins_t, d.label, d.weight, score,
-                                 mask_gh, mask_count, allowed, key)
+                                 mask_gh, mask_count, allowed, cegb_pen,
+                                 key)
 
             @jax.jit
-            def step_goss(score, allowed, key):
+            def step_goss(score, allowed, cegb_pen, key):
                 return step_goss_impl(d.bins, d.bins_t, d.label, d.weight,
-                                      score, d.valid_mask, allowed, key)
+                                      score, d.valid_mask, allowed,
+                                      cegb_pen, key)
 
             @jax.jit
             def step_custom(score, g, h, mask_gh, mask_count, allowed,
-                            key):
+                            cegb_pen, key):
                 return step_custom_impl(d.bins, d.bins_t, score, g, h,
-                                        mask_gh, mask_count, allowed, key)
+                                        mask_gh, mask_count, allowed,
+                                        cegb_pen, key)
 
             valid_update = plain_valid_update
         else:
@@ -691,36 +724,38 @@ class GBDT:
             sharded_step = shard_map(
                 step_impl, mesh=mesh,
                 in_specs=(bins_spec, bt_spec, row1, w_spec, row2, row1,
-                          row1, rep, rep),
+                          row1, rep, rep, rep),
                 out_specs=out_specs, check_vma=False)
             sharded_goss = shard_map(
                 step_goss_impl, mesh=mesh,
                 in_specs=(bins_spec, bt_spec, row1, w_spec, row2, row1,
-                          rep, rep),
+                          rep, rep, rep),
                 out_specs=out_specs, check_vma=False)
             grad_spec = row2 if K > 1 else row1
             sharded_custom = shard_map(
                 step_custom_impl, mesh=mesh,
                 in_specs=(bins_spec, bt_spec, row2, grad_spec, grad_spec,
-                          row1, row1, rep, rep),
+                          row1, row1, rep, rep, rep),
                 out_specs=out_specs, check_vma=False)
 
             @jax.jit
-            def step(score, mask_gh, mask_count, allowed, key):
+            def step(score, mask_gh, mask_count, allowed, cegb_pen, key):
                 return sharded_step(d.bins, d.bins_t, d.label, d.weight,
                                     score, mask_gh, mask_count, allowed,
-                                    key)
+                                    cegb_pen, key)
 
             @jax.jit
-            def step_goss(score, allowed, key):
+            def step_goss(score, allowed, cegb_pen, key):
                 return sharded_goss(d.bins, d.bins_t, d.label, d.weight,
-                                    score, d.valid_mask, allowed, key)
+                                    score, d.valid_mask, allowed,
+                                    cegb_pen, key)
 
             @jax.jit
             def step_custom(score, g, h, mask_gh, mask_count, allowed,
-                            key):
+                            cegb_pen, key):
                 return sharded_custom(d.bins, d.bins_t, score, g, h,
-                                      mask_gh, mask_count, allowed, key)
+                                      mask_gh, mask_count, allowed,
+                                      cegb_pen, key)
 
             if self._shard_features:
                 # feature-parallel valid sets are replicated (prediction
@@ -768,11 +803,11 @@ class GBDT:
                     if goss:
                         stacked, _lid, ns = step_goss_impl(
                             bins, bins_t, label, weight, sc, valid_mask,
-                            allowed_all, bkey)
+                            allowed_all, None, bkey)
                     else:
                         stacked, _lid, ns = step_impl(
                             bins, bins_t, label, weight, sc, valid_mask,
-                            valid_mask, allowed_all, bkey)
+                            valid_mask, allowed_all, None, bkey)
                     return ns, stacked
                 return jax.lax.scan(body, score, keys)
 
@@ -805,6 +840,18 @@ class GBDT:
         self._apply_renewed = apply_renewed
 
     # ------------------------------------------------------------------
+    def _cegb_pen(self) -> Optional[jnp.ndarray]:
+        """Per-feature coupled CEGB penalty ([F_pad]); zero for features
+        the model already uses. None when CEGB is off (the split-cost
+        part is static in GrowConfig)."""
+        if self._cegb_coupled is None:
+            return None
+        if self._cegb_pen_cache is None:
+            self._cegb_pen_cache = jnp.asarray(
+                np.where(self._cegb_used, 0.0, self._cegb_coupled)
+                .astype(np.float32))
+        return self._cegb_pen_cache
+
     def _feature_mask(self) -> jnp.ndarray:
         F = self.num_features
         frac = self.config.feature_fraction
@@ -864,14 +911,16 @@ class GBDT:
             g = self._pad_custom(grad)
             h = self._pad_custom(hess)
             stacked, leaf_ids, new_score = self._step_custom(
-                self.score, g, h, mask_gh, mask_count, allowed, key)
+                self.score, g, h, mask_gh, mask_count, allowed,
+                self._cegb_pen(), key)
         elif goss_active:
             stacked, leaf_ids, new_score = self._step_goss(
-                self.score, allowed, key)
+                self.score, allowed, self._cegb_pen(), key)
         else:
             mask_gh, mask_count = self._bagging_masks()
             stacked, leaf_ids, new_score = self._step(
-                self.score, mask_gh, mask_count, allowed, key)
+                self.score, mask_gh, mask_count, allowed,
+                self._cegb_pen(), key)
         # start device->host copies of the (tiny) tree arrays immediately:
         # over a tunneled TPU each sync transfer is a latency round-trip,
         # so issue them all async and overlap with the step itself
@@ -919,9 +968,16 @@ class GBDT:
         leading class dim) to the model list."""
         for k in range(self.num_class):
             arrays = {key: v[k] for key, v in host.items()}
-            self.models.append(Tree.from_device(
+            t = Tree.from_device(
                 arrays, self._learning_rate(),
-                self.train_set.bin_mappers, self.train_set.used_features))
+                self.train_set.bin_mappers, self.train_set.used_features)
+            if self._cegb_used is not None and t.num_nodes:
+                newly = np.setdiff1d(t.split_feature[:t.num_nodes],
+                                     np.flatnonzero(self._cegb_used))
+                if len(newly):
+                    self._cegb_used[newly] = True
+                    self._cegb_pen_cache = None   # refresh on next step
+            self.models.append(t)
 
     def can_fuse_iters(self) -> bool:
         """True when boosting iterations are expressible as one scanned
@@ -936,7 +992,8 @@ class GBDT:
                             or c.pos_bagging_fraction < 1.0
                             or c.neg_bagging_fraction < 1.0))
         return (self.fobj is None and not renews and not use_bagging
-                and c.feature_fraction >= 1.0 and not self.valid_data)
+                and c.feature_fraction >= 1.0 and not self.valid_data
+                and self._cegb_coupled is None)
 
     def train_chunk(self, n_iters: int) -> None:
         """Run ``n_iters`` boosting iterations in one device dispatch

@@ -244,6 +244,12 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # device owns F/D features — the reference's ReduceScatter layout) or
     # "psum" (full replicated reduce)
     "tpu_hist_reduce": _P("str", "scatter"),
+    # leaf-histogram storage: "pool" keeps the [L+1, F, B, 3] carry and
+    # derives siblings by subtraction (the reference's HistogramPool);
+    # "rebuild" computes BOTH children per round in one scan — the masks
+    # pack into the matmul N dim, so the second child rides the MXU's
+    # 128-lane padding — bounding memory to O(leaf_batch * F * B)
+    "tpu_hist_mode": _P("str", "pool"),
 }
 
 def parse_interaction_constraints(spec) -> List[List[int]]:
@@ -402,6 +408,9 @@ class Config:
         if str(self.tpu_hist_reduce) not in ("scatter", "psum"):
             log.fatal(f"Unknown tpu_hist_reduce {self.tpu_hist_reduce!r} "
                       f"(expected 'scatter' or 'psum')")
+        if str(self.tpu_hist_mode) not in ("pool", "rebuild"):
+            log.fatal(f"Unknown tpu_hist_mode {self.tpu_hist_mode!r} "
+                      f"(expected 'pool' or 'rebuild')")
         for m in (self.monotone_constraints or []):
             if int(m) not in (-1, 0, 1):
                 log.fatal("monotone_constraints must be -1, 0 or 1, "

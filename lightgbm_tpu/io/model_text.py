@@ -59,8 +59,15 @@ class HostModel:
             # map used-feature indices -> original feature indices
             t2.split_feature = np.array(
                 [used[int(f)] for f in t.split_feature], dtype=np.int32)
+            # zero-missing features serialize as missing_type NONE: this
+            # learner bins NaN into the zero bin and routes zeros by
+            # threshold (never by default-direction), and stock LightGBM
+            # with mt=none converts NaN to 0 at predict — identical
+            # routing; writing mt=zero would make stock route
+            # |x|<=1e-35 by a default_left this learner never fits
             mt = np.array(
-                [_MISSING_CODE[ds.bin_mappers[int(f)].missing_type]
+                [0 if ds.bin_mappers[int(f)].missing_type == "zero"
+                 else _MISSING_CODE[ds.bin_mappers[int(f)].missing_type]
                  for f in t2.split_feature], dtype=np.int32)
             if t2.is_categorical is not None:
                 # categorical missing routes via bitset-miss, not the
@@ -118,7 +125,10 @@ class HostModel:
     def predict(self, data, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
                 pred_leaf: bool = False,
-                pred_contrib: bool = False) -> np.ndarray:
+                pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
         from .dataset import Dataset as _DS
         X = _DS._to_matrix(data)
         n = X.shape[0]
@@ -138,8 +148,31 @@ class HostModel:
         if pred_contrib:
             return self._predict_contrib(X, use, K)
         raw = np.zeros((n, K), dtype=np.float64)
+        obj0 = self.objective_str.split(" ")[0]
+        early = (pred_early_stop and not self.average_output
+                 and obj0 in ("binary", "multiclass", "softmax",
+                              "multiclassova"))
+        active = np.ones(n, dtype=bool) if early else None
         for i, t in enumerate(use):
-            raw[:, (t0 + i) % K] += t.predict_raw(X)
+            k = (t0 + i) % K
+            if active is None:
+                raw[:, k] += t.predict_raw(X)
+            else:
+                # prediction early-stopping (pred_early_stop;
+                # reference: src/boosting/prediction_early_stop.cpp):
+                # rows whose margin already exceeds the threshold stop
+                # traversing further trees
+                if active.any():
+                    raw[active, k] += t.predict_raw(X[active])
+                if (i + 1) % (pred_early_stop_freq * K) == 0:
+                    if K == 1:
+                        # reference binary margin: 2 * |raw|
+                        # (prediction_early_stop.cpp)
+                        margin = 2.0 * np.abs(raw[:, 0])
+                    else:
+                        part = np.partition(raw, K - 2, axis=1)
+                        margin = part[:, -1] - part[:, -2]
+                    active &= margin < pred_early_stop_margin
         if self.average_output and len(use):
             raw /= (len(use) // K)
         if raw_score:
@@ -266,6 +299,133 @@ def save_model_string(model: HostModel) -> str:
         out += f"[{k}: {v}]\n"
     out += "end of parameters\n\npandas_categorical:null\n"
     return out
+
+
+def _node_json(model: HostModel, t: Tree, mt, nd: int) -> Dict:
+    """Nested node dict (GBDT::DumpModel tree_structure layout)."""
+    if t.num_nodes == 0 or nd < 0:
+        leaf = -nd - 1 if nd < 0 else 0
+        return {"leaf_index": int(leaf),
+                "leaf_value": float(t.leaf_value[leaf]),
+                "leaf_weight": float(t.leaf_weight[leaf]),
+                "leaf_count": int(t.leaf_count[leaf])}
+    is_cat = (t.is_categorical is not None
+              and bool(t.is_categorical[nd]))
+    node = {
+        "split_index": int(nd),
+        "split_feature": int(t.split_feature[nd]),
+        "split_gain": float(t.split_gain[nd]),
+        "threshold": (float(t.threshold_real[nd]) if not is_cat
+                      else int(t.threshold_real[nd])),
+        "decision_type": "==" if is_cat else "<=",
+        "default_left": bool(t.default_left[nd]),
+        "missing_type": {0: "None", 1: "Zero", 2: "NaN"}.get(
+            int(mt[nd]) if mt is not None else 0, "None"),
+        "internal_value": float(t.internal_value[nd]),
+        "internal_count": int(t.internal_count[nd]),
+    }
+    lc, rc = int(t.left_child[nd]), int(t.right_child[nd])
+    node["left_child"] = _node_json(model, t, mt, lc)
+    node["right_child"] = _node_json(model, t, mt, rc)
+    return node
+
+
+def dump_model_json(model: HostModel, num_iteration: int = -1,
+                    start_iteration: int = 0) -> Dict:
+    """JSON-able model dict (GBDT::DumpModel, gbdt_model_text.cpp)."""
+    import sys
+    max_leaves = max((t.num_leaves for t in model.trees), default=1)
+    sys.setrecursionlimit(max(sys.getrecursionlimit(),
+                              4 * max_leaves + 1000))
+    K = max(model.num_tree_per_iteration, 1)
+    total_iters = len(model.trees) // K
+    if num_iteration <= 0:
+        num_iteration = total_iters - start_iteration
+    num_iteration = min(num_iteration, total_iters - start_iteration)
+    t0 = start_iteration * K
+    trees = []
+    for i in range(t0, t0 + num_iteration * K):
+        t = model.trees[i]
+        mt = (model.missing_types[i]
+              if model.missing_types is not None else None)
+        trees.append({
+            "tree_index": i,
+            "num_leaves": int(t.num_leaves),
+            "num_cat": (int(len(t.cat_boundaries) - 1)
+                        if t.cat_boundaries is not None else 0),
+            "shrinkage": float(t.shrinkage),
+            "tree_structure": _node_json(
+                model, t, mt, 0 if t.num_nodes else -1),
+        })
+    return {
+        "name": "tree",
+        "version": "v4",
+        "num_class": model.num_class,
+        "num_tree_per_iteration": model.num_tree_per_iteration,
+        "label_index": model.label_index,
+        "max_feature_idx": model.max_feature_idx,
+        "objective": model.objective_str,
+        "average_output": model.average_output,
+        "feature_names": list(model.feature_names),
+        "feature_infos": list(model.feature_infos),
+        "tree_info": trees,
+    }
+
+
+def _node_c(t: Tree, nd: int, indent: str) -> str:
+    """Nested if/else for one node (convert_model C export)."""
+    if t.num_nodes == 0 or nd < 0:
+        leaf = -nd - 1 if nd < 0 else 0
+        return f"{indent}return {float(t.leaf_value[leaf]):.17g};\n"
+    f = int(t.split_feature[nd])
+    is_cat = (t.is_categorical is not None
+              and bool(t.is_categorical[nd]))
+    if is_cat:
+        ci = int(t.threshold_real[nd])
+        words = t.cat_threshold[
+            t.cat_boundaries[ci]:t.cat_boundaries[ci + 1]]
+        vals = [int(v) for v in np.flatnonzero(np.unpackbits(
+            np.ascontiguousarray(words).view(np.uint8),
+            bitorder="little"))]
+        cond = " || ".join(f"(int)x[{f}] == {v}" for v in vals) or "0"
+        cond = f"(!isnan(x[{f}]) && ({cond}))"
+    else:
+        thr = float(t.threshold_real[nd])
+        dl = "1" if bool(t.default_left[nd]) else "0"
+        cond = f"(isnan(x[{f}]) ? {dl} : (x[{f}] <= {thr:.17g}))"
+    out = f"{indent}if ({cond}) {{\n"
+    out += _node_c(t, int(t.left_child[nd]), indent + "  ")
+    out += f"{indent}}} else {{\n"
+    out += _node_c(t, int(t.right_child[nd]), indent + "  ")
+    out += f"{indent}}}\n"
+    return out
+
+
+def model_to_c(model: HostModel) -> str:
+    """Standalone C prediction code (the reference's convert_model
+    task, src/application/application.cpp: if-else model export)."""
+    import sys
+    max_leaves = max((t.num_leaves for t in model.trees), default=1)
+    sys.setrecursionlimit(max(sys.getrecursionlimit(),
+                              4 * max_leaves + 1000))
+    K = max(model.num_tree_per_iteration, 1)
+    parts = ["#include <math.h>\n\n"]
+    for i, t in enumerate(model.trees):
+        parts.append(f"static double PredictTree{i}"
+                     f"(const double* x) {{\n")
+        parts.append(_node_c(t, 0 if t.num_nodes else -1, "  "))
+        parts.append("}\n\n")
+    parts.append(f"void Predict(const double* x, double* out) {{\n")
+    for k in range(K):
+        parts.append(f"  out[{k}] = 0.0;\n")
+    for i in range(len(model.trees)):
+        parts.append(f"  out[{i % K}] += PredictTree{i}(x);\n")
+    if model.average_output and model.trees:
+        n_iter = len(model.trees) // K
+        for k in range(K):
+            parts.append(f"  out[{k}] /= {n_iter};\n")
+    parts.append("}\n")
+    return "".join(parts)
 
 
 def _parse_kv_block(text: str) -> Dict[str, str]:

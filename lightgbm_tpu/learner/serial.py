@@ -88,6 +88,16 @@ class GrowConfig:
     # the bundle maps before split finding. Mutually exclusive with
     # hist_scatter / feature_axis (engine enforces).
     has_bundles: bool = False
+    # True: no [L+1, F, B, 3] histogram pool — both children are
+    # histogrammed directly each round (one scan, masks packed into the
+    # matmul N dim), bounding memory to O(leaf_batch * F * B)
+    hist_rebuild: bool = False
+    # per-NODE column sampling (ColSampler feature_fraction_bynode)
+    feature_fraction_bynode: float = 1.0
+    # CEGB gain discounts (cost_effective_gradient_boosting.hpp)
+    has_cegb: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
     # categorical split search (zero-cost when has_categorical=False)
     has_categorical: bool = False
     max_cat_threshold: int = 32
@@ -114,7 +124,10 @@ class GrowConfig:
             cat_smooth=self.cat_smooth, cat_l2=self.cat_l2,
             max_cat_to_onehot=self.max_cat_to_onehot,
             min_data_per_group=self.min_data_per_group,
-            has_monotone=self.has_monotone)
+            has_monotone=self.has_monotone,
+            has_cegb=self.has_cegb,
+            cegb_tradeoff=self.cegb_tradeoff,
+            cegb_penalty_split=self.cegb_penalty_split)
 
 
 class GrowState(NamedTuple):
@@ -178,6 +191,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               groups: jax.Array = None,
               bundle: Tuple = None,
               chan_scale: jax.Array = None,
+              node_key: jax.Array = None,
+              cegb_pen: jax.Array = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -300,10 +315,34 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 if is_cat is not None else None)
         mn_s = (jax.lax.dynamic_slice_in_dim(mono, off, F_s)
                 if mono is not None else None)
+        cp_s = (jax.lax.dynamic_slice_in_dim(cegb_pen, off, F_s)
+                if cegb_pen is not None else None)
     else:
         off = jnp.zeros((), i32)
-        nb_s, hn_s, al_s, ic_s, mn_s = (feat_num_bin, feat_has_nan,
-                                        allowed_feature, is_cat, mono)
+        nb_s, hn_s, al_s, ic_s, mn_s, cp_s = (feat_num_bin, feat_has_nan,
+                                              allowed_feature, is_cat,
+                                              mono, cegb_pen)
+
+    def bynode_mask(allow2, round_tag):
+        """Exact-k per-child column sampling
+        (ColSampler feature_fraction_bynode): k is the fraction of each
+        child's CURRENTLY-ALLOWED features (after per-tree sampling,
+        interaction constraints, and shard padding), like the
+        reference's per-node resample of the valid set."""
+        if cfg.feature_fraction_bynode >= 1.0 or node_key is None:
+            return allow2
+        C2 = allow2.shape[0]
+        kk = jax.random.fold_in(node_key, round_tag)
+        u = jnp.where(allow2, jax.random.uniform(kk, (C2, F_meta)),
+                      jnp.inf)
+        n_allow = jnp.sum(allow2, axis=1)
+        k_idx = jnp.clip(
+            jnp.ceil(cfg.feature_fraction_bynode
+                     * n_allow.astype(jnp.float32)).astype(i32) - 1,
+            0, F_meta - 1)
+        kth = jnp.take_along_axis(jnp.sort(u, axis=1), k_idx[:, None],
+                                  axis=1)
+        return allow2 & (u <= kth)
 
     def search_best(hists, sums, lowers=None, uppers=None, allows=None):
         """Best split per child: ``hists [C, F_h, B, 3]`` (mode-reduced),
@@ -342,11 +381,13 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             al_e = jnp.take_along_axis(allows_g, elected, axis=1)
             ic_e = is_cat[elected] if is_cat is not None else None
             mn_e = mono[elected] if mono is not None else None
+            cp_e = cegb_pen[elected] if cegb_pen is not None else None
             best = jax.vmap(
-                lambda h, s, nb, hn, al, ic, mn, lo, hi: find_best_split(
+                lambda h, s, nb, hn, al, ic, mn, cp, lo, hi:
+                find_best_split(
                     h, s, nb, hn, al, scfg, is_cat=ic, mono=mn,
-                    out_lower=lo, out_upper=hi))(
-                hist_e, sums, nb_e, hn_e, al_e, ic_e, mn_e,
+                    out_lower=lo, out_upper=hi, cegb_pen=cp))(
+                hist_e, sums, nb_e, hn_e, al_e, ic_e, mn_e, cp_e,
                 lowers, uppers)
             best["feature"] = jnp.take_along_axis(
                 elected, best["feature"][:, None], axis=1)[:, 0]
@@ -358,7 +399,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                     if (mode_scatter or mode_feature) else allows_g)
         best = jax.vmap(lambda h, s, al, lo, hi: find_best_split(
             h, s, nb_s, hn_s, al, scfg, is_cat=ic_s, mono=mn_s,
-            out_lower=lo, out_upper=hi))(
+            out_lower=lo, out_upper=hi, cegb_pen=cp_s))(
             hists, sums, allows_s, lowers, uppers)
         best["feature"] = best["feature"] + off
         if mode_scatter:
@@ -388,10 +429,14 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         root_allow = jnp.any(groups, axis=0) & allowed_feature  # [F_meta]
     else:
         root_allow = None
+    root_allows = (root_allow[None] if root_allow is not None else None)
+    if cfg.feature_fraction_bynode < 1.0 and node_key is not None:
+        base = (root_allows if root_allows is not None
+                else jnp.broadcast_to(allowed_feature, (1, F_meta)))
+        root_allows = bynode_mask(base, L + 7)
     root_best = jax.tree.map(
         lambda a: a[0], search_best(
-            root_hist[None], root_sums[None],
-            allows=None if root_allow is None else root_allow[None]))
+            root_hist[None], root_sums[None], allows=root_allows))
 
     def set0(arr, value):
         return arr.at[0].set(value)
@@ -401,8 +446,12 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         num_leaves=jnp.array(1, i32),
         has_split=jnp.isfinite(root_best["gain"]),
         leaf_id=leaf_id0,
-        leaf_hist=set0(jnp.zeros((L + 1,) + root_hist.shape, jnp.float32),
-                       root_hist),
+        # rebuild mode carries no pool — a 1-element placeholder keeps
+        # the NamedTuple structure static
+        leaf_hist=(jnp.zeros((1, 1, 1, 1), jnp.float32)
+                   if cfg.hist_rebuild else
+                   set0(jnp.zeros((L + 1,) + root_hist.shape,
+                                  jnp.float32), root_hist)),
         leaf_sums=set0(jnp.zeros((L + 1, 3), jnp.float32), root_sums),
         leaf_depth=jnp.zeros(L + 1, i32),
         best_gain=set0(jnp.full(L + 1, NEG_INF), root_best["gain"]),
@@ -550,22 +599,34 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             goes_left = jnp.where(is_cat_r, cat_left, goes_left)
         leaf_id = jnp.where(selected & ~goes_left, new_leaf_r, lf)
 
-        # ---- smaller-child histograms, one fused scan ------------------
         lsums = s.best_left_sums[tl_safe]      # [Kb, 3]
         rsums = s.best_right_sums[tl_safe]
         psums = s.leaf_sums[tl_safe]
-        left_smaller = lsums[:, 2] <= rsums[:, 2]
-        small_ids = jnp.where(
-            valid, jnp.where(left_smaller, top_leaf, new_ids),
-            -1).astype(i32)
-        hist_small = hist_multi(leaf_id, small_ids)      # [Kb, F, B, 3]
-        parent_hist = s.leaf_hist[tl_safe]
-        hist_large = parent_hist - hist_small
-        ls4 = left_smaller[:, None, None, None]
-        left_hist = jnp.where(ls4, hist_small, hist_large)
-        right_hist = jnp.where(ls4, hist_large, hist_small)
-        leaf_hist = (s.leaf_hist.at[tl_safe].set(left_hist)
-                     .at[new_ids].set(right_hist))
+        if cfg.hist_rebuild:
+            # ---- both children direct, one fused scan ------------------
+            # 2*Kb membership masks pack into the matmul N dimension;
+            # the sibling's histogram rides the MXU padding that the
+            # subtraction trick exists to avoid on CPUs
+            both_ids = jnp.concatenate([
+                jnp.where(valid, top_leaf, -1),
+                jnp.where(valid, new_ids, -1)]).astype(i32)
+            hist2 = hist_multi(leaf_id, both_ids)    # [2Kb, F, B, 3]
+            left_hist, right_hist = hist2[:Kb], hist2[Kb:]
+            leaf_hist = s.leaf_hist
+        else:
+            # ---- smaller-child histogram + sibling subtraction ---------
+            left_smaller = lsums[:, 2] <= rsums[:, 2]
+            small_ids = jnp.where(
+                valid, jnp.where(left_smaller, top_leaf, new_ids),
+                -1).astype(i32)
+            hist_small = hist_multi(leaf_id, small_ids)  # [Kb, F, B, 3]
+            parent_hist = s.leaf_hist[tl_safe]
+            hist_large = parent_hist - hist_small
+            ls4 = left_smaller[:, None, None, None]
+            left_hist = jnp.where(ls4, hist_small, hist_large)
+            right_hist = jnp.where(ls4, hist_large, hist_small)
+            leaf_hist = (s.leaf_hist.at[tl_safe].set(left_hist)
+                         .at[new_ids].set(right_hist))
 
         depth2 = s.leaf_depth[tl_safe] + 1
         lvals = leaf_out(lsums)
@@ -615,6 +676,11 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             child_allow = jnp.concatenate([allow_k, allow_k])
         else:
             child_used = child_allow = None
+        if cfg.feature_fraction_bynode < 1.0 and node_key is not None:
+            base = (child_allow if child_allow is not None
+                    else jnp.broadcast_to(allowed_feature,
+                                          (2 * Kb, F_meta)))
+            child_allow = bynode_mask(base, s.split_idx)
 
         # ---- best splits for all 2*Kb children -------------------------
         child_hists = jnp.concatenate([left_hist, right_hist])

@@ -158,6 +158,44 @@ class AUCMetric(Metric):
         return [("auc", auc)]
 
 
+class AUCMuMetric(Metric):
+    """Multiclass AUC-mu (Kleiman & Page; reference:
+    src/metric/multiclass_metric.hpp AucMuMetric, UNVERIFIED): mean over
+    class pairs (i, j) of the binary AUC separating class-i rows from
+    class-j rows, scored by pred[:, i] - pred[:, j]; optional
+    ``auc_mu_weights`` flat (num_class x num_class) weight matrix."""
+
+    name = "auc_mu"
+    higher_better = True
+
+    def eval(self, pred, label, weight, query_boundaries=None):
+        pred = np.asarray(pred)
+        if pred.ndim != 2:
+            return [("auc_mu", 0.5)]
+        K = pred.shape[1]
+        label = np.asarray(label).astype(np.int64)
+        wm = None
+        aw = getattr(self.config, "auc_mu_weights", None)
+        if aw:
+            wm = np.asarray(aw, dtype=np.float64).reshape(K, K)
+        auc_bin = AUCMetric(self.config)
+        total, wsum = 0.0, 0.0
+        for i in range(K):
+            for j in range(i + 1, K):
+                m = (label == i) | (label == j)
+                if not m.any() or (label[m] == i).all() \
+                        or (label[m] == j).all():
+                    continue
+                s = pred[m, i] - pred[m, j]
+                y = (label[m] == i).astype(np.float64)
+                w = None if weight is None else weight[m]
+                a = auc_bin.eval(s, y, w)[0][1]
+                pw = wm[i, j] if wm is not None else 1.0
+                total += pw * a
+                wsum += pw
+        return [("auc_mu", total / wsum if wsum else 0.5)]
+
+
 class AveragePrecisionMetric(Metric):
     name = "average_precision"
     higher_better = True
@@ -305,6 +343,7 @@ _REGISTRY: Dict[str, type] = {
     "binary_logloss": BinaryLoglossMetric, "binary": BinaryLoglossMetric,
     "binary_error": BinaryErrorMetric,
     "auc": AUCMetric, "average_precision": AveragePrecisionMetric,
+    "auc_mu": AUCMuMetric,
     "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
     "softmax": MultiLoglossMetric, "multiclassova": MultiLoglossMetric,
     "multi_error": MultiErrorMetric,

@@ -34,7 +34,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 def _hist_kernel(bins_ref, vals_ref, leaf_ref, small_ref, out_ref, *,
                  num_bins: int, n_feat: int, n_leaves: int, n_chan: int):
-    i = pl.program_id(0)
+    i = pl.program_id(1)      # row-block index (feature block is dim 0)
     # bins stored int8 to halve HBM traffic; wrapped values are restored
     # with & 0xFF after widening (cheap at [F, R])
     bins_blk = bins_ref[...].astype(jnp.int32) & 0xFF    # [F, R]
@@ -94,32 +94,45 @@ def multi_leaf_histogram(bins_t: jax.Array, vals_t: jax.Array,
     R = rows_per_block
     assert n % R == 0, f"n={n} must be a multiple of rows_per_block={R}"
 
-    kernel = functools.partial(_hist_kernel, num_bins=num_bins, n_feat=F,
-                               n_leaves=K, n_chan=C)
+    # feature blocking keeps the [B*F_blk, K*C] VMEM accumulator (and the
+    # transient one-hot) bounded for wide datasets (MSLR F=136+); at
+    # F*B <= 8192 this is a single block, identical to the unblocked form
+    F_blk = min(F, max(1, 8192 // num_bins))
+    n_fb = (F + F_blk - 1) // F_blk
+    F_pad = n_fb * F_blk
+    if F_pad > F:
+        bins_t = jnp.concatenate(
+            [bins_t, jnp.zeros((F_pad - F, n), bins_t.dtype)])
+
+    kernel = functools.partial(_hist_kernel, num_bins=num_bins,
+                               n_feat=F_blk, n_leaves=K, n_chan=C)
     out = pl.pallas_call(
         kernel,
-        grid=(n // R,),
+        grid=(n_fb, n // R),
         in_specs=[
-            pl.BlockSpec((F, R), lambda i: (0, i),
+            pl.BlockSpec((F_blk, R), lambda j, i: (j, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((C, R), lambda i: (0, i),
+            pl.BlockSpec((C, R), lambda j, i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, R), lambda i: (0, i),
+            pl.BlockSpec((1, R), lambda j, i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((K, 1), lambda i: (0, 0),
+            pl.BlockSpec((K, 1), lambda j, i: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((num_bins * F, K * C), lambda i: (0, 0),
+        out_specs=pl.BlockSpec((num_bins * F_blk, K * C),
+                               lambda j, i: (j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((num_bins * F, K * C),
+        out_shape=jax.ShapeDtypeStruct((num_bins * F_pad, K * C),
                                        jnp.float32),
         cost_estimate=pl.CostEstimate(
-            flops=2 * F * num_bins * n * K * C,
+            flops=2 * F_pad * num_bins * n * K * C,
             bytes_accessed=bins_t.size + vals_t.size * 4 + leaf_id.size * 4,
             transcendentals=0),
     )(bins_t, vals_t, leaf_id.reshape(1, n), small_ids.reshape(K, 1))
-    # [B*F, K*C] -> [K, F, B, C]
-    return out.reshape(num_bins, F, K, C).transpose(2, 1, 0, 3)
+    # per block j, row q = b * F_blk + f_local
+    out = out.reshape(n_fb, num_bins, F_blk, K, C)
+    out = out.transpose(3, 0, 2, 1, 4).reshape(K, F_pad, num_bins, C)
+    return out[:, :F]
 
 
 def multi_leaf_histogram_xla(bins: jax.Array, vals: jax.Array,

@@ -52,6 +52,12 @@ class SplitConfig:
     # outputs, and thresholds whose outputs violate the feature's
     # direction are vetoed
     has_monotone: bool = False
+    # CEGB (cost_effective_gradient_boosting.hpp): split gains are
+    # discounted by tradeoff * (penalty_split * n_rows_in_leaf +
+    # per-feature coupled penalty for model-unused features)
+    has_cegb: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
 
 
 def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
@@ -102,7 +108,8 @@ def _pack_bitset(inset: jax.Array, n_words: int) -> jax.Array:
 
 def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
                             is_cat, cfg: SplitConfig,
-                            out_lower=None, out_upper=None):
+                            out_lower=None, out_upper=None,
+                            cegb_pen=None):
     """Candidate categorical gains: ``(all_gain [F, 3, B], orders
     [F, 2, B], cum [F, 2, B, 3], valid_bin [F, B])`` — modes are
     (one-hot, sorted-asc, sorted-desc). With monotone bounds active,
@@ -176,11 +183,23 @@ def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
 
     all_gain = jnp.concatenate(
         [gain_oh[:, None, :], gain_sorted], axis=1)           # [F, 3, B]
+    if cfg.has_cegb:
+        # penalize BEFORE the argmax so the per-feature selection sees
+        # the discounted gains, mirroring the numerical path
+        pen = cfg.cegb_tradeoff * cfg.cegb_penalty_split * pc
+        if cegb_pen is not None:
+            pen = pen + cegb_pen
+            all_gain = all_gain - pen[:, None, None]
+        else:
+            all_gain = all_gain - pen
+        all_gain = jnp.where(all_gain > cfg.min_gain_to_split, all_gain,
+                             NEG_INF)
     return all_gain, orders, cum, valid_bin
 
 
 def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
-                      cfg: SplitConfig, out_lower=None, out_upper=None):
+                      cfg: SplitConfig, out_lower=None, out_upper=None,
+                      cegb_pen=None):
     """Best categorical split (one-hot + sorted many-vs-many).
 
     Reference: ``FindBestThresholdCategoricalInner``
@@ -200,7 +219,7 @@ def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]
     all_gain, orders, cum, valid_bin = _categorical_candidates(
         hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
-        out_lower=out_lower, out_upper=out_upper)
+        out_lower=out_lower, out_upper=out_upper, cegb_pen=cegb_pen)
     flat = all_gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -315,6 +334,9 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
             hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
             out_lower=out_lower, out_upper=out_upper)
         pf = jnp.maximum(pf, jnp.max(all_gain, axis=(1, 2)))
+    if cfg.has_cegb:
+        pen = cfg.cegb_tradeoff * cfg.cegb_penalty_split * parent_sums[2]
+        pf = jnp.where(jnp.isfinite(pf), pf - pen, pf)
     return pf
 
 
@@ -341,7 +363,8 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
                     allowed_feature: jax.Array,
                     cfg: SplitConfig,
                     is_cat: jax.Array = None, mono=None,
-                    out_lower=None, out_upper=None
+                    out_lower=None, out_upper=None,
+                    cegb_pen: jax.Array = None
                     ) -> Dict[str, jax.Array]:
     """Best split for one leaf given its histogram.
 
@@ -372,6 +395,16 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
                                        has_nan, num_allowed, cfg,
                                        mono=mono, out_lower=out_lower,
                                        out_upper=out_upper)
+    if cfg.has_cegb:
+        # CEGB gain discount; candidates whose PENALIZED gain no longer
+        # clears min_gain_to_split are rejected (the actual pruning)
+        pen = cfg.cegb_tradeoff * cfg.cegb_penalty_split * parent_sums[2]
+        if cegb_pen is not None:
+            pen = pen + cegb_pen                    # [F] coupled penalty
+            gain = gain - pen[:, None, None]
+        else:
+            gain = gain - pen
+        gain = jnp.where(gain > cfg.min_gain_to_split, gain, NEG_INF)
     flat = gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -384,7 +417,7 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
     if cfg.has_categorical and is_cat is not None:
         cgain, cfeat, cleft, cinset = _categorical_best(
             hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
-            out_lower=out_lower, out_upper=out_upper)
+            out_lower=out_lower, out_upper=out_upper, cegb_pen=cegb_pen)
         take_cat = cgain > best_gain
         best_gain = jnp.maximum(best_gain, cgain)
         feature = jnp.where(take_cat, cfeat, feature)

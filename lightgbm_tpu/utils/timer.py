@@ -1,0 +1,71 @@
+"""Phase timers + profiler hooks.
+
+Reference: the reference's global timer (include/LightGBM/utils/log.h
+CHECK/timer macros + `Log::Debug` per-phase timings, UNVERIFIED — empty
+mount, see SURVEY.md banner). TPU-side, deep kernel profiling belongs to
+``jax.profiler`` (trace viewer / xprof); these wall-clock phase timers
+cover the host orchestration the profiler doesn't attribute.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+from . import log
+
+_ACCUM: Dict[str, float] = defaultdict(float)
+_COUNT: Dict[str, int] = defaultdict(int)
+
+
+@contextlib.contextmanager
+def timed(name: str) -> Iterator[None]:
+    """Accumulate wall time under ``name`` (nestable)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _ACCUM[name] += time.perf_counter() - t0
+        _COUNT[name] += 1
+
+
+def timer_totals() -> Dict[str, float]:
+    return dict(_ACCUM)
+
+
+def reset_timers() -> None:
+    _ACCUM.clear()
+    _COUNT.clear()
+
+
+def log_timers() -> None:
+    """Debug-log accumulated phase times (the reference prints its
+    global timer table at shutdown in debug builds)."""
+    for name in sorted(_ACCUM, key=lambda k: -_ACCUM[k]):
+        log.debug(f"{name}: {_ACCUM[name]:.3f}s "
+                  f"({_COUNT[name]} calls)")
+
+
+def start_trace(log_dir: str) -> None:
+    """Begin a jax.profiler trace (view with TensorBoard/xprof)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Trace a block when ``log_dir`` is set; no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()

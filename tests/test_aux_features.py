@@ -1,0 +1,149 @@
+"""Aux features: auc_mu, prediction early-stop, JSON dump, C export,
+feature_fraction_bynode, CEGB, timers."""
+import json
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=2000, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X @ rng.normal(size=f) + rng.normal(scale=0.5, size=n) > 0)
+    return X, y.astype(float)
+
+
+def test_auc_mu_metric():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2000, 6))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    res = {}
+    lgb.train({"objective": "multiclass", "num_class": 3,
+               "metric": "auc_mu", "num_leaves": 15, "verbosity": -1},
+              lgb.Dataset(X[:1500], label=y[:1500].astype(float)),
+              num_boost_round=15,
+              valid_sets=[lgb.Dataset(X[:1500],
+                                      label=y[:1500].astype(float))
+                          .create_valid(X[1500:],
+                                        label=y[1500:].astype(float))],
+              callbacks=[lgb.record_evaluation(res)])
+    mu = res["valid_0"]["auc_mu"]
+    assert mu[-1] > 0.9
+    assert mu[-1] >= mu[0] - 0.02
+
+
+def test_pred_early_stop_matches_full():
+    X, y = _binary_data()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=40)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=8.0)
+    # confident rows freeze early; class decisions must agree
+    assert np.mean((full > 0.5) == (es > 0.5)) > 0.995
+
+
+def test_dump_model_json():
+    X, y = _binary_data(n=1200)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    d = bst.dump_model()
+    json.dumps(d)              # JSON-serializable
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 3
+    t0 = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in t0 and "left_child" in t0
+    # walk to a leaf
+    node = t0
+    while "leaf_value" not in node:
+        node = node["left_child"]
+    assert isinstance(node["leaf_value"], float)
+
+
+def test_model_to_c_compiles_and_matches():
+    import ctypes, subprocess, tempfile, os
+    X, y = _binary_data(n=1000)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    code = bst.model_to_c()
+    d = tempfile.mkdtemp()
+    src = os.path.join(d, "model.c")
+    so = os.path.join(d, "model.so")
+    open(src, "w").write(code)
+    subprocess.run(["gcc", "-O2", "-shared", "-fPIC", "-o", so, src],
+                   check=True)
+    lib = ctypes.CDLL(so)
+    lib.Predict.argtypes = [ctypes.POINTER(ctypes.c_double),
+                            ctypes.POINTER(ctypes.c_double)]
+    out = np.zeros(1)
+    raws = []
+    for row in X[:50]:
+        row = np.ascontiguousarray(row, dtype=np.float64)
+        lib.Predict(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        raws.append(out[0])
+    np.testing.assert_allclose(
+        raws, bst.predict(X[:50], raw_score=True), rtol=1e-6, atol=1e-6)
+
+
+def test_feature_fraction_bynode():
+    X, y = _binary_data(n=2500, f=12, seed=3)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "feature_fraction_bynode": 0.5},
+                    lgb.Dataset(X, label=y), num_boost_round=15)
+    p = bst.predict(X)
+    assert np.mean((p > 0.5) == y) > 0.85
+    # different nodes saw different feature subsets -> more distinct
+    # features used than a single 0.5 subset would allow
+    used = set()
+    for t in bst.engine.models:
+        used.update(t.split_feature[:t.num_nodes].tolist())
+    assert len(used) > 6
+
+
+def test_cegb_penalties_shrink_feature_set():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(3000, 10))
+    w = np.linspace(1.5, 0.5, 10)     # every feature informative
+    y = ((X * w).sum(axis=1) + rng.normal(scale=0.3, size=3000) > 0)
+    base = lgb.train({"objective": "binary", "num_leaves": 15,
+                      "verbosity": -1},
+                     lgb.Dataset(X, label=y.astype(float)),
+                     num_boost_round=10)
+    pen = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_coupled": [50.0] * 10},
+                    lgb.Dataset(X, label=y.astype(float)),
+                    num_boost_round=10)
+    def n_used(b):
+        used = set()
+        for t in b.engine.models:
+            used.update(t.split_feature[:t.num_nodes].tolist())
+        return len(used)
+    assert n_used(pen) < n_used(base)
+    assert np.mean((pen.predict(X) > 0.5) == y) > 0.8
+
+
+def test_cegb_split_penalty_prunes():
+    X, y = _binary_data(n=2000, seed=5)
+    free = lgb.train({"objective": "binary", "num_leaves": 63,
+                      "verbosity": -1}, lgb.Dataset(X, label=y),
+                     num_boost_round=5)
+    pen = lgb.train({"objective": "binary", "num_leaves": 63,
+                     "verbosity": -1, "cegb_penalty_split": 2.0},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    leaves_free = sum(t.num_leaves for t in free.engine.models)
+    leaves_pen = sum(t.num_leaves for t in pen.engine.models)
+    assert leaves_pen < leaves_free
+
+
+def test_timers():
+    from lightgbm_tpu.utils.timer import reset_timers, timed, timer_totals
+    reset_timers()
+    with timed("phase_a"):
+        x = sum(range(1000))
+    assert timer_totals()["phase_a"] >= 0
