@@ -24,8 +24,8 @@ N_HOLDOUT = 100_000
 N_FEATURES = 28
 NUM_LEAVES = 127
 MAX_BIN = 255
-WARMUP_ITERS = 10
-BENCH_ITERS = 10
+WARMUP_ITERS = 40     # one full fused chunk (tpu_fuse_iters default)
+BENCH_ITERS = 40
 CPU_LIGHTGBM_BASELINE_ITERS_PER_SEC = 1.0  # UNVERIFIED, see BASELINE.md
 
 

@@ -1,0 +1,57 @@
+import sys, os; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time, functools, numpy as np, jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from lightgbm_tpu.ops.pallas_histogram import multi_leaf_histogram, _hist_kernel
+
+F, n, B, K, C, R = 28, 1_048_576, 256, 16, 3, 2048
+rng = np.random.default_rng(0)
+bins_t = jnp.asarray(rng.integers(0, 255, size=(F, n)).astype(np.int8))
+vals_t = jnp.asarray(rng.normal(size=(C, n)).astype(np.float32))
+leaf_id = jnp.zeros(n, jnp.int32)
+small = jnp.arange(K, dtype=jnp.int32)
+
+def bench(fn, tag):
+    out = fn(); jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(5):
+        out = fn()
+    jax.block_until_ready(out)
+    print(f"{tag}: {(time.time()-t0)/5*1000:.1f} ms/scan")
+
+bench(lambda: multi_leaf_histogram(bins_t, vals_t, leaf_id, small, num_bins=B, rows_per_block=R), "2D-grid current")
+
+# old 1-D grid formulation
+def _kernel1d(bins_ref, vals_ref, leaf_ref, small_ref, out_ref, *, num_bins, n_feat, n_leaves, n_chan):
+    i = pl.program_id(0)
+    bins_blk = bins_ref[...].astype(jnp.int32) & 0xFF
+    vals_blk = vals_ref[...]
+    lid = leaf_ref[...]
+    sm = small_ref[...]
+    mask = (lid == sm).astype(jnp.float32)
+    rhs = (mask[:, None, :] * vals_blk[None, :, :]).reshape(n_leaves * n_chan, -1).astype(jnp.bfloat16)
+    big = pltpu.repeat(bins_blk, num_bins, axis=0)
+    iota_b = (jax.lax.broadcasted_iota(jnp.int32, (n_feat * num_bins, 1), 0) // n_feat)
+    onehot = (big == iota_b).astype(jnp.bfloat16)
+    contrib = jax.lax.dot_general(onehot, rhs, dimension_numbers=(((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    @pl.when(i == 0)
+    def _(): out_ref[...] = contrib
+    @pl.when(i > 0)
+    def _(): out_ref[...] += contrib
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "rows_per_block"))
+def hist1d(bins_t, vals_t, leaf_id, small_ids, *, num_bins, rows_per_block=2048):
+    F, n = bins_t.shape; C = vals_t.shape[0]; K = small_ids.shape[0]; R = rows_per_block
+    kernel = functools.partial(_kernel1d, num_bins=num_bins, n_feat=F, n_leaves=K, n_chan=C)
+    out = pl.pallas_call(kernel, grid=(n // R,),
+        in_specs=[pl.BlockSpec((F, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+                  pl.BlockSpec((C, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+                  pl.BlockSpec((K, 1), lambda i: (0, 0), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((num_bins * F, K * C), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((num_bins * F, K * C), jnp.float32),
+        cost_estimate=pl.CostEstimate(flops=2*F*num_bins*n*K*C, bytes_accessed=bins_t.size + vals_t.size*4 + leaf_id.size*4, transcendentals=0),
+    )(bins_t, vals_t, leaf_id.reshape(1, n), small_ids.reshape(K, 1))
+    return out.reshape(num_bins, F, K, C).transpose(2, 1, 0, 3)
+
+bench(lambda: hist1d(bins_t, vals_t, leaf_id, small, num_bins=B, rows_per_block=R), "1D-grid old")

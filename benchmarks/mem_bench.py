@@ -1,3 +1,4 @@
+import sys, os; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import sys, time, numpy as np, jax
 import lightgbm_tpu as lgb
 from lightgbm_tpu.boosting.gbdt import GBDT

@@ -1,0 +1,23 @@
+import sys, os; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time, numpy as np, jax
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1_000_000, 28)); y = (X @ rng.normal(size=28) > 0).astype(np.float64)
+cfg = Config({"objective": "binary", "num_leaves": 127, "max_bin": 255,
+              "verbosity": -1, "tpu_fuse_iters": 1})  # UNFUSED
+eng = GBDT(cfg, lgb.Dataset(X, label=y))
+eng.train_one_iter(); jax.block_until_ready(eng.score)
+t0 = time.time()
+for _ in range(5): eng.train_one_iter()
+jax.block_until_ready(eng.score)
+print(f"unfused: {5/(time.time()-t0):.2f} iters/s", flush=True)
+
+cfg2 = Config({"objective": "binary", "num_leaves": 127, "max_bin": 255,
+               "verbosity": -1})
+eng2 = GBDT(cfg2, lgb.Dataset(X, label=y))
+eng2.train_chunk(10); jax.block_until_ready(eng2.score)
+t0 = time.time(); eng2.train_chunk(10); jax.block_until_ready(eng2.score)
+print(f"fused(10): {10/(time.time()-t0):.2f} iters/s", flush=True)

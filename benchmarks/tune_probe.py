@@ -1,0 +1,15 @@
+import sys, os; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time, numpy as np, jax
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+
+lb, fuse = int(sys.argv[1]), int(sys.argv[2])
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1_000_000, 28)); y = (X @ rng.normal(size=28) > 0).astype(np.float64)
+cfg = Config({"objective": "binary", "num_leaves": 127, "max_bin": 255,
+              "verbosity": -1, "tpu_leaf_batch": lb, "tpu_fuse_iters": fuse})
+eng = GBDT(cfg, lgb.Dataset(X, label=y))
+eng.train_chunk(fuse); jax.block_until_ready(eng.score)
+t0 = time.time(); eng.train_chunk(fuse); jax.block_until_ready(eng.score)
+print(f"RESULT leaf_batch={lb} fuse={fuse}: {fuse/(time.time()-t0):.2f} iters/s", flush=True)

@@ -235,11 +235,11 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "tpu_double_precision_hist": _P("bool", False),
     # leaves expanded per growth round; 1 = exact reference leaf-wise
     # order, larger batches fuse K leaf histograms into one data scan
-    "tpu_leaf_batch": _P("int", 16, [], (1, 256)),
+    "tpu_leaf_batch": _P("int", 32, [], (1, 256)),
     "tpu_use_pallas": _P("bool", True),
     # boosting iterations fused into one device dispatch (lax.scan) when
     # the pure-jit path applies (no callbacks/valid sets/host bagging)
-    "tpu_fuse_iters": _P("int", 10, [], (1, 1000)),
+    "tpu_fuse_iters": _P("int", 40, [], (1, 1000)),
     # data-parallel histogram reduction: "scatter" (psum_scatter, each
     # device owns F/D features — the reference's ReduceScatter layout) or
     # "psum" (full replicated reduce)

@@ -63,8 +63,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         else:
             init_forest = init_model
 
-    booster = Booster(params=params, train_set=train_set,
-                      init_forest=init_forest)
+    from .utils.timer import log_timers, timed
+    with timed("dataset construction + engine build"):
+        booster = Booster(params=params, train_set=train_set,
+                          init_forest=init_forest)
     if valid_sets:
         valid_names = valid_names or [f"valid_{i}"
                                       for i in range(len(valid_sets))]
@@ -96,8 +98,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
             and not cfg.is_provide_training_metric and fobj is None
             and cfg.tpu_fuse_iters > 1 and cfg.snapshot_freq <= 0
             and booster.engine.can_fuse_iters()):
-        booster.engine.train_chunk(num_boost_round)
+        with timed("boosting (fused chunks)"):
+            booster.engine.train_chunk(num_boost_round)
         booster.best_iteration = booster.current_iteration()
+        log_timers()
         return booster
 
     for it in range(num_boost_round):
@@ -107,7 +111,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             evaluation_result_list=None)
         for cb in callbacks_before:
             cb(env_pre)
-        booster.update(fobj=fobj)
+        with timed("boosting (per-iter)"):
+            booster.update(fobj=fobj)
         if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
             # mid-training checkpoint (Application snapshot_freq semantics)
             booster.save_model(
@@ -135,6 +140,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             break
     if booster.best_iteration < 0:
         booster.best_iteration = booster.current_iteration()
+    log_timers()
     return booster
 
 

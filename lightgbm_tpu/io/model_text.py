@@ -79,6 +79,7 @@ class HostModel:
                 bias = float(engine.init_scores[ti % engine.num_class])
                 t2.leaf_value = t2.leaf_value + bias
                 t2.internal_value = t2.internal_value + bias
+            t2.node_missing_type = mt    # host traversal NaN semantics
             trees.append(t2)
             missing_types.append(mt)
 
@@ -311,12 +312,23 @@ def _node_json(model: HostModel, t: Tree, mt, nd: int) -> Dict:
                 "leaf_count": int(t.leaf_count[leaf])}
     is_cat = (t.is_categorical is not None
               and bool(t.is_categorical[nd]))
+    if is_cat:
+        # LightGBM's DumpModel writes the category left-set as
+        # "v1||v2||..." (tree.cpp NodeToJSON), not the group index
+        ci = int(t.threshold_real[nd])
+        words = t.cat_threshold[
+            t.cat_boundaries[ci]:t.cat_boundaries[ci + 1]]
+        cats = np.flatnonzero(np.unpackbits(
+            np.ascontiguousarray(words).view(np.uint8),
+            bitorder="little"))
+        thr_repr = "||".join(str(int(c)) for c in cats)
+    else:
+        thr_repr = float(t.threshold_real[nd])
     node = {
         "split_index": int(nd),
         "split_feature": int(t.split_feature[nd]),
         "split_gain": float(t.split_gain[nd]),
-        "threshold": (float(t.threshold_real[nd]) if not is_cat
-                      else int(t.threshold_real[nd])),
+        "threshold": thr_repr,
         "decision_type": "==" if is_cat else "<=",
         "default_left": bool(t.default_left[nd]),
         "missing_type": {0: "None", 1: "Zero", 2: "NaN"}.get(
@@ -392,7 +404,15 @@ def _node_c(t: Tree, nd: int, indent: str) -> str:
     else:
         thr = float(t.threshold_real[nd])
         dl = "1" if bool(t.default_left[nd]) else "0"
-        cond = f"(isnan(x[{f}]) ? {dl} : (x[{f}] <= {thr:.17g}))"
+        nmt = getattr(t, "node_missing_type", None)
+        code = int(nmt[nd]) if nmt is not None else 2
+        if code == 0:      # none: NaN behaves as 0.0
+            cond = f"((isnan(x[{f}]) ? 0.0 : x[{f}]) <= {thr:.17g})"
+        elif code == 1:    # zero: |x|<=1e-35 and NaN take the default
+            cond = (f"((isnan(x[{f}]) || fabs(x[{f}]) <= 1e-35) ? {dl} "
+                    f": (x[{f}] <= {thr:.17g}))")
+        else:              # nan
+            cond = f"(isnan(x[{f}]) ? {dl} : (x[{f}] <= {thr:.17g}))"
     out = f"{indent}if ({cond}) {{\n"
     out += _node_c(t, int(t.left_child[nd]), indent + "  ")
     out += f"{indent}}} else {{\n"
@@ -501,6 +521,7 @@ def load_model_string(text: str) -> HostModel:
         # drop the leading tree index line
         body = body.split("\n", 1)[1] if "\n" in body else body
         t, mt = _parse_tree_block(body)
+        t.node_missing_type = mt
         trees.append(t)
         missing_types.append(mt)
     return HostModel(

@@ -365,7 +365,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 hists = expand_hist(hists, local_sums)
             pf = jax.vmap(lambda h, s, al, lo, hi: per_feature_gains(
                 h, s, feat_num_bin, feat_has_nan, al, scfg, is_cat,
-                mono=mono, out_lower=lo, out_upper=hi))(
+                mono=mono, out_lower=lo, out_upper=hi,
+                cegb_pen=cegb_pen))(
                 hists, local_sums, allows_g, lowers, uppers)  # [C, F]
             k_ = min(cfg.top_k, F_meta)
             vk = min(2 * cfg.top_k, F_meta)

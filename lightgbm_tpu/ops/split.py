@@ -316,7 +316,8 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
                       num_bin: jax.Array, has_nan: jax.Array,
                       allowed_feature: jax.Array, cfg: SplitConfig,
                       is_cat: jax.Array = None, mono=None,
-                      out_lower=None, out_upper=None) -> jax.Array:
+                      out_lower=None, out_upper=None,
+                      cegb_pen=None) -> jax.Array:
     """Best achievable gain per feature (``[F]``) — the local VOTE metric
     of the voting-parallel learner (PV-Tree,
     voting_parallel_tree_learner.cpp: machines propose their top-k
@@ -329,14 +330,20 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
                                     out_lower=out_lower,
                                     out_upper=out_upper)
     pf = jnp.max(gain, axis=(1, 2))                            # [F]
+    if cfg.has_cegb:
+        # vote on PENALIZED gains (the coupled term changes feature
+        # ranking); categorical gains below are already penalized
+        # inside _categorical_candidates
+        pen = cfg.cegb_tradeoff * cfg.cegb_penalty_split * parent_sums[2]
+        if cegb_pen is not None:
+            pen = pen + cegb_pen
+        pf = jnp.where(jnp.isfinite(pf), pf - pen, pf)
     if cfg.has_categorical and is_cat is not None:
         all_gain, _, _, _ = _categorical_candidates(
             hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
-            out_lower=out_lower, out_upper=out_upper)
+            out_lower=out_lower, out_upper=out_upper,
+            cegb_pen=cegb_pen)
         pf = jnp.maximum(pf, jnp.max(all_gain, axis=(1, 2)))
-    if cfg.has_cegb:
-        pen = cfg.cegb_tradeoff * cfg.cegb_penalty_split * parent_sums[2]
-        pf = jnp.where(jnp.isfinite(pf), pf - pen, pf)
     return pf
 
 

@@ -88,6 +88,9 @@ class Tree:
         out = np.zeros(n, dtype=np.int64)
         has_cat = (self.is_categorical is not None
                    and np.any(self.is_categorical))
+        # per-node missing codes (0 none / 1 zero / 2 nan), attached by
+        # HostModel; without them NaN takes the default direction
+        nmt = getattr(self, "node_missing_type", None)
         for _ in range(self.num_nodes + 1):
             if not active.any():
                 break
@@ -97,7 +100,20 @@ class Tree:
             thr = self.threshold_real[nd]
             dl = self.default_left[nd]
             miss = np.isnan(vals)
-            go_left = np.where(miss, dl, vals <= thr)
+            if nmt is None:
+                go_left = np.where(miss, dl, vals <= thr)
+            else:
+                # stock semantics per missing type: none converts NaN
+                # to 0.0; zero routes |x|<=1e-35 (and NaN) by default
+                # direction; nan routes NaN by default direction
+                mtn = nmt[nd]
+                v0 = np.where(miss, 0.0, vals)
+                zeroish = miss | (np.abs(v0) <= 1e-35)
+                go_left = np.where(
+                    mtn == 2, np.where(miss, dl, vals <= thr),
+                    np.where(mtn == 1,
+                             np.where(zeroish, dl, v0 <= thr),
+                             v0 <= thr))
             if has_cat:
                 catn = self.is_categorical[nd]
                 go_left = np.where(catn, self._cat_go_left(thr, vals),
