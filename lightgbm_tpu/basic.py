@@ -40,7 +40,7 @@ class Booster:
             train_set.params.setdefault("max_bin", self.config.max_bin)
             for key in ("min_data_in_bin", "bin_construct_sample_cnt",
                         "use_missing", "zero_as_missing",
-                        "data_random_seed"):
+                        "data_random_seed", "linear_tree"):
                 train_set.params.setdefault(key, getattr(self.config, key))
             self._engine = create_boosting(self.config, train_set,
                                            init_forest=init_forest)

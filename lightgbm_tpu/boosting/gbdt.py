@@ -345,6 +345,16 @@ class GBDT:
         self.valid_data: List[_DeviceData] = []
         self.valid_scores: List[jnp.ndarray] = []
         self.valid_names: List[str] = []
+        self._valid_ds: List[Dataset] = []
+
+        # linear trees (linear_tree_learner.cpp): structures grown by the
+        # standard jitted learner, leaves refined by host-side per-leaf
+        # weighted ridge (learner/linear.py)
+        self.linear_tree = bool(config.linear_tree)
+        if self.linear_tree and self.train_set._raw_for_linear is None:
+            log.fatal("linear_tree=True requires the Dataset to be "
+                      "constructed with linear_tree in its params "
+                      "(raw feature values must be retained)")
 
         self._rng_feature = np.random.RandomState(
             config.feature_fraction_seed)
@@ -399,6 +409,20 @@ class GBDT:
                 self.train_set.used_features))
         self.iter_ = len(self.models) // self.num_class
         if self.models:
+            if any(getattr(t, "is_linear", False) for t in self.models):
+                # linear leaves need raw features: host-side rebuild
+                if self.train_set._raw_for_linear is None:
+                    log.fatal("Continuing from a linear-tree model "
+                              "requires linear_tree=True params")
+                Xu = self.train_set._raw_for_linear
+                raw_np = np.zeros((self.data.n_pad, self.num_class),
+                                  dtype=np.float32)
+                for i, t in enumerate(self.models):
+                    raw_np[:self.data.n, i % self.num_class] += \
+                        t.predict_raw(Xu)
+                self.score = self.score + self.data._place(
+                    raw_np, extra_dims=2)
+                return
             stacked, class_idx = self._stack_models(0, len(self.models))
             raw, _ = forest_predict_binned(
                 stacked, self._logical_bins(), self.feat_num_bin,
@@ -408,6 +432,9 @@ class GBDT:
     def add_valid(self, ds: Dataset, name: str) -> None:
         # feature-parallel keeps valid sets unsharded (prediction needs
         # every column); data/voting shard valid rows like train rows
+        if self.linear_tree and not ds._constructed:
+            ds.params.setdefault("linear_tree", True)
+        self._valid_ds.append(ds)
         dd = _DeviceData(ds.construct(), self.rows_per_block,
                          None if self._shard_features else self.mesh)
         score0 = self._init_score_tile(dd)
@@ -898,6 +925,7 @@ class GBDT:
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> None:
         """One boosting iteration (optionally with custom fobj grads)."""
+        score_pre = self.score       # gradient point (linear-leaf refit)
         allowed = self._feature_mask()
         key = jax.random.PRNGKey(self.config.objective_seed + self.iter_)
         # GOSS kicks in after 1/learning_rate iterations (goss.hpp keeps
@@ -953,7 +981,68 @@ class GBDT:
             self.valid_scores = self._valid_update(self.valid_scores,
                                                    stacked)
         self._append_host_trees(self._fetch_tree_arrays(stacked))
+        if self.linear_tree and grad is None:
+            self._apply_linear_fit(leaf_ids, score_pre)
         self.iter_ += 1
+
+    def _apply_linear_fit(self, leaf_ids, score_pre) -> None:
+        """Refine the just-grown trees' leaves with per-leaf weighted
+        ridge models and patch the train/valid scores with the delta
+        (LinearTreeLearner semantics; learner/linear.py)."""
+        from ..learner.linear import fit_linear_leaves, predict_linear
+        K = self.num_class
+        n = self.data.n
+        Xu = self.train_set._raw_for_linear
+        old = np.asarray(score_pre)[:n]
+        lid = np.asarray(leaf_ids)[:, :n]
+        sc = jnp.asarray(old[:, 0] if K == 1 else old)
+        label = jnp.asarray(self.train_set.metadata.label)
+        w = self.train_set.metadata.weight
+        w = None if w is None else jnp.asarray(w)
+        if getattr(self.objective, "needs_rng", False):
+            # the SAME key the grown tree's gradients used
+            g, h = self.objective.get_gradients(
+                sc, label, w, key=jax.random.PRNGKey(
+                    self.config.objective_seed + self.iter_))
+        else:
+            g, h = self.objective.get_gradients(sc, label, w)
+        g = np.asarray(g).reshape(n, -1)
+        h = np.asarray(h).reshape(n, -1)
+        bag = None
+        if self._bag_mask is not None:
+            bag = np.asarray(self._bag_mask)[:n]
+        deltas = np.zeros((self.data.n_pad, K), dtype=np.float32)
+        for k in range(K):
+            t = self.models[-K + k]
+            # mask BOTH g and h so out-of-bag rows drop out of both
+            # sides of the normal equations
+            hk = h[:, k] if bag is None else h[:, k] * bag
+            gk = g[:, k] if bag is None else g[:, k] * bag
+            delta = fit_linear_leaves(
+                t, lid[k], Xu, gk, hk, self.config.lambda_l2,
+                self.config.linear_lambda, self._learning_rate())
+            deltas[:n, k] = delta
+        self.score = self.score + self.data._place(deltas, extra_dims=2)
+        for vi, dd in enumerate(self.valid_data):
+            Xv = getattr(self._valid_ds[vi], "_raw_for_linear", None)
+            if Xv is None:
+                if not getattr(self, "_warned_valid_linear", False):
+                    log.warning(
+                        "valid set was constructed without linear_tree "
+                        "params; its eval metrics track constant leaves,"
+                        " not the linear model")
+                    self._warned_valid_linear = True
+                continue
+            vdeltas = np.zeros((dd.n_pad, K), dtype=np.float32)
+            for k in range(K):
+                t = self.models[-K + k]
+                if not getattr(t, "is_linear", False):
+                    continue
+                leaf = t.predict_leaf_raw(Xv)
+                dv = predict_linear(t, Xv, leaf) - t.leaf_value[leaf]
+                vdeltas[:dd.n, k] = dv
+            self.valid_scores[vi] = (self.valid_scores[vi]
+                                     + dd._place(vdeltas, extra_dims=2))
 
     def _fetch_tree_arrays(self, stacked) -> Dict[str, np.ndarray]:
         """Device->host transfer of the stacked tree arrays: issue every
@@ -993,7 +1082,7 @@ class GBDT:
                             or c.neg_bagging_fraction < 1.0))
         return (self.fobj is None and not renews and not use_bagging
                 and c.feature_fraction >= 1.0 and not self.valid_data
-                and self._cegb_coupled is None)
+                and self._cegb_coupled is None and not self.linear_tree)
 
     def train_chunk(self, n_iters: int) -> None:
         """Run ``n_iters`` boosting iterations in one device dispatch
@@ -1170,6 +1259,19 @@ class GBDT:
                 start_iteration: int = 0, num_iteration: int = -1,
                 pred_leaf: bool = False) -> np.ndarray:
         """Predict on raw features (binned through the train mappers)."""
+        if self.linear_tree:
+            # linear leaves need raw feature values — host-model path
+            # (cached; the model list only grows)
+            from ..io.model_text import HostModel
+            cache = getattr(self, "_hm_cache", (None, None))
+            if cache[0] != len(self.models):
+                cache = (len(self.models),
+                         HostModel.from_engine(self, self.config))
+                self._hm_cache = cache
+            return cache[1].predict(X, raw_score=raw_score,
+                                    start_iteration=start_iteration,
+                                    num_iteration=num_iteration,
+                                    pred_leaf=pred_leaf)
         X = Dataset._to_matrix(X)
         ds = self.train_set
         if X.shape[1] != ds.num_total_features:

@@ -82,6 +82,7 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
                     (0.0, None)),
     "lambda_l2": _P("float", 0.0, ["reg_lambda", "lambda",
                                    "l2_regularization"], (0.0, None)),
+    "linear_tree": _P("bool", False, ["linear_trees"]),
     "linear_lambda": _P("float", 0.0, [], (0.0, None)),
     "min_gain_to_split": _P("float", 0.0, ["min_split_gain"], (0.0, None)),
     "drop_rate": _P("float", 0.1, ["rate_drop"], (0.0, 1.0)),

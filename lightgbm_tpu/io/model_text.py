@@ -73,13 +73,25 @@ class HostModel:
                 # categorical missing routes via bitset-miss, not the
                 # numerical default-direction machinery
                 mt[t2.is_categorical[:len(mt)]] = 0
+            t2.node_missing_type = mt    # host traversal NaN semantics
+            if getattr(t, "is_linear", False):
+                t2.is_linear = True
+                t2.leaf_coeff = list(t.leaf_coeff)
+                # leaf feature indices: used-space -> original
+                t2.leaf_features = [[used[f] for f in lf]
+                                    for lf in t.leaf_features]
             if ti < engine.num_class and not engine.average_output:
                 # fold init score into the first iteration's trees
                 # (AddBias); RF trees already carry the bias per-tree
                 bias = float(engine.init_scores[ti % engine.num_class])
                 t2.leaf_value = t2.leaf_value + bias
                 t2.internal_value = t2.internal_value + bias
-            t2.node_missing_type = mt    # host traversal NaN semantics
+                if getattr(t2, "is_linear", False):
+                    # linear intercepts carry the bias too
+                    t2.leaf_coeff = [
+                        None if b is None else
+                        np.concatenate([b[:-1], [b[-1] + bias]])
+                        for b in t2.leaf_coeff]
             trees.append(t2)
             missing_types.append(mt)
 
@@ -202,6 +214,11 @@ class HostModel:
 
     def _predict_contrib(self, X, trees, K):
         from ..ops.shap import tree_shap_batch
+        if any(getattr(t, "is_linear", False) for t in trees):
+            # the reference likewise refuses SHAP for linear trees —
+            # constant-leaf attributions would not sum to the prediction
+            log.fatal("pred_contrib is not supported for linear-tree "
+                      "models")
         n = X.shape[0]
         n_feat = self.max_feature_idx + 1
         out = np.zeros((n, K, n_feat + 1), dtype=np.float64)
@@ -251,9 +268,29 @@ def _tree_to_string(t: Tree, missing_type: Optional[np.ndarray]) -> str:
         _arr("internal_value", t.internal_value[:nn], "{:g}"),
         _arr("internal_weight", [0.0] * nn, "{:g}"),
         _arr("internal_count", t.internal_count[:nn]),
-        "is_linear=0",
+        f"is_linear={1 if getattr(t, 'is_linear', False) else 0}",
         f"shrinkage={t.shrinkage:g}",
     ]
+    if getattr(t, "is_linear", False):
+        # linear-leaf payload: intercept per leaf (leaf_const), flat
+        # feature/coefficient lists with per-leaf counts
+        # (gbdt_model_text.cpp linear-tree block layout)
+        nl = t.num_leaves
+        consts, counts, feats, coefs = [], [], [], []
+        for lf in range(nl):
+            beta = t.leaf_coeff[lf] if lf < len(t.leaf_coeff) else None
+            if beta is None:
+                consts.append(float(t.leaf_value[lf]))
+                counts.append(0)
+            else:
+                consts.append(float(beta[-1]))
+                counts.append(len(t.leaf_features[lf]))
+                feats.extend(int(f) for f in t.leaf_features[lf])
+                coefs.extend(float(c) for c in beta[:-1])
+        lines.append(_arr("leaf_const", consts, "{:.17g}"))
+        lines.append(_arr("num_features", counts))
+        lines.append(_arr("leaf_features", feats))
+        lines.append(_arr("leaf_coeff", coefs, "{:.17g}"))
     if num_cat > 0:
         # LightGBM layout: threshold[i] indexes cat_boundaries, whose
         # [idx, idx+1) range delimits uint32 words in cat_threshold
@@ -487,6 +524,7 @@ def _parse_tree_block(block: str) -> (Tree, np.ndarray):
                                   dtype=np.int64)
         cat_threshold = np.array(kv["cat_threshold"].split(),
                                  dtype=np.float64).astype(np.uint32)
+    is_linear = int(kv.get("is_linear", 0)) == 1
     t = Tree(
         num_leaves=num_leaves,
         split_feature=geti("split_feature", nn),
@@ -506,6 +544,30 @@ def _parse_tree_block(block: str) -> (Tree, np.ndarray):
         cat_threshold=cat_threshold,
         is_categorical=is_categorical,
     )
+    if is_linear and "leaf_const" in kv:
+        consts = getf("leaf_const", num_leaves)
+        counts = geti("num_features", num_leaves)
+        feats_flat = (np.array(kv["leaf_features"].split(), dtype=np.int64)
+                      if kv.get("leaf_features", "").strip() else
+                      np.zeros(0, np.int64))
+        coefs_flat = (np.array(kv["leaf_coeff"].split(), dtype=np.float64)
+                      if kv.get("leaf_coeff", "").strip() else
+                      np.zeros(0))
+        t.is_linear = True
+        t.leaf_features = []
+        t.leaf_coeff = []
+        off = 0
+        for lf in range(num_leaves):
+            c = int(counts[lf])
+            if c == 0:
+                t.leaf_features.append([])
+                t.leaf_coeff.append(None)
+            else:
+                t.leaf_features.append(
+                    [int(f) for f in feats_flat[off:off + c]])
+                t.leaf_coeff.append(np.concatenate(
+                    [coefs_flat[off:off + c], [consts[lf]]]))
+            off += c
     return t, missing_type
 
 

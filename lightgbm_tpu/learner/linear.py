@@ -1,0 +1,124 @@
+"""Linear-tree leaf refinement.
+
+Reference: ``LinearTreeLearner`` (src/treelearner/linear_tree_learner.cpp,
+UNVERIFIED — empty mount, see SURVEY.md banner): after the tree STRUCTURE
+is grown by the standard learner, each leaf's constant output is replaced
+by a ridge-regularized linear model over the numerical features on the
+leaf's root-to-leaf path, fitted by hessian-weighted least squares on the
+leaf's rows (the reference solves with Eigen; coefficient count per leaf
+= path depth, so the systems are tiny).
+
+TPU-first split of labor: the tree growth stays the jitted device
+program; the per-leaf solves are host numpy (a handful of <=depth-sized
+normal equations — scalar work the MXU has no business doing). Rows with
+NaN in any leaf feature fall back to the constant, like the reference.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def _parents_map(tree) -> dict:
+    parents = {}
+    for nd in range(tree.num_nodes):
+        for child in (int(tree.left_child[nd]), int(tree.right_child[nd])):
+            parents[child] = nd
+    return parents
+
+
+def path_features(tree, leaf: int, max_feats: int,
+                  parents: Optional[dict] = None) -> List[int]:
+    """Numerical feature indices on the root->leaf path (deduped,
+    root-first)."""
+    if parents is None:
+        parents = _parents_map(tree)
+    out: List[int] = []
+    node = -leaf - 1
+    while node in parents:
+        nd = parents[node]
+        f = int(tree.split_feature[nd])
+        is_cat = (tree.is_categorical is not None
+                  and bool(tree.is_categorical[nd]))
+        if not is_cat and f not in out:
+            out.append(f)
+        node = nd
+    out.reverse()
+    return out[:max_feats]
+
+
+def fit_linear_leaves(tree, leaf_id: np.ndarray, X_used: np.ndarray,
+                      g: np.ndarray, h: np.ndarray, lambda_l2: float,
+                      linear_lambda: float, shrinkage: float,
+                      min_rows: int = 10) -> np.ndarray:
+    """Fit per-leaf linear models in place; returns the per-row delta
+    (new_prediction - old_constant) * shrinkage for the score update.
+
+    The target of leaf L's weighted ridge is the Newton step: minimize
+    ``sum_i h_i (beta . [x_i, 1] + g_i / h_i)^2 + reg`` — whose constant
+    -only solution is exactly the leaf's standard output.
+    """
+    n = len(leaf_id)
+    delta = np.zeros(n, dtype=np.float64)
+    nl = tree.num_leaves
+    coeffs: List[Optional[np.ndarray]] = [None] * nl
+    feats: List[List[int]] = [[] for _ in range(nl)]
+    consts = np.array(tree.leaf_value, dtype=np.float64)
+    parents = _parents_map(tree)
+    for lf in range(nl):
+        rows = np.flatnonzero(leaf_id == lf)
+        pf = path_features(tree, lf, max_feats=10, parents=parents)
+        if len(rows) < max(min_rows, len(pf) + 2) or not pf:
+            continue
+        A = X_used[np.ix_(rows, pf)]
+        ok = np.isfinite(A).all(axis=1)
+        rows, A = rows[ok], A[ok]
+        if len(rows) < max(min_rows, len(pf) + 2):
+            continue
+        hw = np.maximum(h[rows], 1e-12)
+        target = -g[rows] / hw
+        Ab = np.concatenate([A, np.ones((len(rows), 1))], axis=1)
+        W = hw[:, None]
+        lhs = Ab.T @ (W * Ab)
+        reg = np.full(len(pf) + 1, lambda_l2 + linear_lambda)
+        reg[-1] = lambda_l2            # intercept: plain l2 only
+        lhs[np.diag_indices_from(lhs)] += reg
+        rhs = Ab.T @ (hw * target)
+        try:
+            beta = np.linalg.solve(lhs, rhs)
+        except np.linalg.LinAlgError:
+            continue
+        if not np.isfinite(beta).all():
+            continue
+        pred = Ab @ beta
+        coeffs[lf] = beta
+        feats[lf] = pf
+        # tree.leaf_value stays the CONSTANT — the non-finite-feature
+        # fallback at predict time, like the reference
+        delta[rows] = pred * shrinkage - consts[lf]
+    tree.leaf_features = feats
+    tree.leaf_coeff = [None if c is None else c * shrinkage
+                       for c in coeffs]
+    tree.is_linear = any(c is not None for c in coeffs)
+    return delta
+
+
+def predict_linear(tree, X_used: np.ndarray,
+                   leaf: np.ndarray) -> np.ndarray:
+    """Leaf outputs with linear models applied (constant fallback for
+    leaves without a model or rows with non-finite features)."""
+    out = np.asarray(tree.leaf_value, dtype=np.float64)[leaf]
+    if not getattr(tree, "is_linear", False):
+        return out
+    for lf, beta in enumerate(tree.leaf_coeff):
+        if beta is None:
+            continue
+        rows = np.flatnonzero(leaf == lf)
+        if not len(rows):
+            continue
+        A = X_used[np.ix_(rows, tree.leaf_features[lf])]
+        ok = np.isfinite(A).all(axis=1)
+        pred = A[ok] @ beta[:-1] + beta[-1]
+        out[rows[ok]] = pred
+    return out

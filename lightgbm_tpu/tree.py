@@ -61,6 +61,9 @@ class Tree:
             return np.full(n, self.leaf_value[0] if len(self.leaf_value)
                            else 0.0)
         leaf = self._leaf_index_raw(X)
+        if getattr(self, "is_linear", False):
+            from .learner.linear import predict_linear
+            return predict_linear(self, X, leaf)
         return self.leaf_value[leaf]
 
     def _cat_go_left(self, cat_idx: np.ndarray,
@@ -214,6 +217,13 @@ class Tree:
         out.split_feature = sf
         out.threshold_bin = tb
         out.cat_bitset_bins = cat_bs
+        if getattr(t, "is_linear", False):
+            # linear leaf payload: feature indices original -> used
+            # (path features are always split features, so validated)
+            out.is_linear = True
+            out.leaf_coeff = list(t.leaf_coeff)
+            out.leaf_features = [[pos[f] for f in lf]
+                                 for lf in t.leaf_features]
         return out
 
     @staticmethod
