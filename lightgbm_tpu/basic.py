@@ -183,6 +183,46 @@ class Booster:
               else self._to_host_model())
         return dump_model_json(hm, num_iteration or -1, start_iteration)
 
+    def trees_to_dataframe(self):
+        """One row per node/leaf (mirrors lightgbm.Booster
+        .trees_to_dataframe; requires pandas)."""
+        import pandas as pd
+        rows = []
+
+        def walk(ti, node, parent_idx, depth):
+            base = {"tree_index": ti, "node_depth": depth,
+                    "parent_index": parent_idx}
+            if "leaf_value" in node:
+                rows.append({**base,
+                             "node_index": f"{ti}-L{node['leaf_index']}",
+                             "split_feature": None, "threshold": None,
+                             "split_gain": None, "decision_type": None,
+                             "missing_type": None,
+                             "value": node["leaf_value"],
+                             "weight": node.get("leaf_weight"),
+                             "count": node.get("leaf_count")})
+                return f"{ti}-L{node['leaf_index']}"
+            me = f"{ti}-S{node['split_index']}"
+            row = {**base, "node_index": me,
+                   "split_feature": node["split_feature"],
+                   "threshold": node["threshold"],
+                   "split_gain": node["split_gain"],
+                   "decision_type": node["decision_type"],
+                   "missing_type": node["missing_type"],
+                   "value": node["internal_value"],
+                   "weight": None,
+                   "count": node["internal_count"]}
+            rows.append(row)
+            row["left_child"] = walk(ti, node["left_child"], me,
+                                     depth + 1)
+            row["right_child"] = walk(ti, node["right_child"], me,
+                                      depth + 1)
+            return me
+
+        for ti, info in enumerate(self.dump_model()["tree_info"]):
+            walk(ti, info["tree_structure"], None, 1)
+        return pd.DataFrame(rows)
+
     def model_to_c(self) -> str:
         """Standalone C prediction source (convert_model if-else)."""
         from .io.model_text import model_to_c

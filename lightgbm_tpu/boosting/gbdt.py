@@ -983,6 +983,17 @@ class GBDT:
         self._append_host_trees(self._fetch_tree_arrays(stacked))
         if self.linear_tree and grad is None:
             self._apply_linear_fit(leaf_ids, score_pre)
+        if self.config.tpu_debug_checks:
+            # NaN/inf guard (aux failure-detection subsystem): catch
+            # divergence at the iteration that produced it
+            for t in self.models[-self.num_class:]:
+                if not np.isfinite(t.leaf_value).all():
+                    log.fatal(f"Non-finite leaf values at iteration "
+                              f"{self.iter_} — check learning_rate/"
+                              f"objective inputs")
+            if not np.isfinite(np.asarray(self.score)).all():
+                log.fatal(f"Non-finite training scores at iteration "
+                          f"{self.iter_}")
         self.iter_ += 1
 
     def _apply_linear_fit(self, leaf_ids, score_pre) -> None:
@@ -1082,7 +1093,8 @@ class GBDT:
                             or c.neg_bagging_fraction < 1.0))
         return (self.fobj is None and not renews and not use_bagging
                 and c.feature_fraction >= 1.0 and not self.valid_data
-                and self._cegb_coupled is None and not self.linear_tree)
+                and self._cegb_coupled is None and not self.linear_tree
+                and not c.tpu_debug_checks)
 
     def train_chunk(self, n_iters: int) -> None:
         """Run ``n_iters`` boosting iterations in one device dispatch

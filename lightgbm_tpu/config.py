@@ -245,6 +245,9 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # device owns F/D features — the reference's ReduceScatter layout) or
     # "psum" (full replicated reduce)
     "tpu_hist_reduce": _P("str", "scatter"),
+    # per-iteration finite checks on tree outputs/scores (the aux
+    # NaN-guard subsystem; costs a host sync per iteration)
+    "tpu_debug_checks": _P("bool", False),
     # leaf-histogram storage: "pool" keeps the [L+1, F, B, 3] carry and
     # derives siblings by subtraction (the reference's HistogramPool);
     # "rebuild" computes BOTH children per round in one scan — the masks

@@ -147,3 +147,16 @@ def test_timers():
     with timed("phase_a"):
         x = sum(range(1000))
     assert timer_totals()["phase_a"] >= 0
+
+
+def test_trees_to_dataframe_and_debug_checks():
+    X, y = _binary_data(n=1000)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "tpu_debug_checks": True},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    df = bst.trees_to_dataframe()
+    assert len(df) == sum(2 * t.num_leaves - 1
+                          for t in bst.engine.models)
+    assert set(df["tree_index"]) == {0, 1, 2}
+    leaves = df[df["split_feature"].isna()]
+    assert (leaves["value"].abs() > 0).any()
