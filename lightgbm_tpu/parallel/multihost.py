@@ -1,0 +1,72 @@
+"""Multi-host (multi-process) training entry.
+
+Reference: the reference's distributed launch story — ``machine_list`` /
+``machines`` + ``local_listen_port`` + rank discovery over sockets/MPI
+(src/network/linkers_socket.cpp, dask.py's cluster orchestration,
+UNVERIFIED — empty mount, see SURVEY.md banner).
+
+TPU-native replacement: ``jax.distributed.initialize`` IS the machine
+list. Each host process calls :func:`init_multihost` once before any
+device use; after that, ``jax.devices()`` spans the whole slice/pod, and
+every learner in this framework (data/voting/feature-parallel) runs
+unchanged — the ``Mesh`` simply contains remote devices, histogram
+reductions ride ICI within a slice and DCN across slices, exactly where
+the reference rides its socket ReduceScatter. There is no separate
+"dask" code path to maintain: sharded arrays + collectives are the
+transport.
+
+On Cloud TPU pods the coordinator/rank/process-count are discovered from
+the TPU metadata automatically (argument-free call); explicit arguments
+mirror the reference's machine_list semantics for other clusters.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import log
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """Join the multi-host training job (call once per host process).
+
+    Equivalent of the reference's ``machines=ip1:port,ip2:port`` +
+    ``machine_list_file`` rank discovery: on TPU pods call with no
+    arguments (auto-discovery); elsewhere pass the coordinator's
+    ``ip:port``, the world size, and this process's rank.
+    """
+    import jax
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # the usual cause: some JAX computation (even device_count())
+        # already initialized the LOCAL backend
+        log.fatal(
+            f"init_multihost must be the FIRST JAX call in the process "
+            f"(before any Dataset/Booster construction, device queries, "
+            f"or is_multihost()): {e}")
+    log.info(f"multi-host initialized: process {jax.process_index()} of "
+             f"{jax.process_count()}, {jax.device_count()} global / "
+             f"{jax.local_device_count()} local devices")
+
+
+def is_multihost() -> bool:
+    """NB: initializes the local backend if nothing has yet — only call
+    AFTER init_multihost (or in single-process jobs)."""
+    import jax
+    return jax.process_count() > 1
+
+
+def global_mesh():
+    """A 1-D data mesh over every device in the job (all hosts) — the
+    same construction the learners use."""
+    from .mesh import create_data_mesh
+    return create_data_mesh()

@@ -116,3 +116,12 @@ def test_voting_with_categorical():
         lgb.Dataset(X, label=y, categorical_feature=[0]),
         num_boost_round=8)
     assert _auc(y, bst.predict(X)) > 0.85
+
+
+def test_multihost_helpers_single_process():
+    """Single-process degenerate behavior of the multi-host entry."""
+    from lightgbm_tpu.parallel.multihost import global_mesh, is_multihost
+    assert is_multihost() is False
+    m = global_mesh()
+    assert m.devices.size == 8
+    assert m.axis_names == ("data",)
