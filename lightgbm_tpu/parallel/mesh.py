@@ -63,12 +63,27 @@ def create_2d_mesh(data: int, feature: int) -> Mesh:
     return Mesh(devs, (DATA_AXIS, FEATURE_AXIS))
 
 
+def put(mesh: Mesh, arr, spec: P):
+    """Place ``arr`` with the given spec. Under a MULTI-HOST mesh the
+    array is assembled from per-process local chunks
+    (``jax.make_array_from_process_local_data``): for sharded specs each
+    process contributes its OWN row shard (the reference's rank-aware
+    ``pre_partition`` load, dataset_loader.cpp); for replicated specs
+    every process must pass identical data."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        import numpy as _np
+        return jax.make_array_from_process_local_data(
+            sharding, _np.asarray(arr))
+    return jax.device_put(arr, sharding)
+
+
 def shard_rows(mesh: Mesh, arr, extra_dims: int = 1):
     """Place an array with its leading (row) axis sharded over DATA_AXIS."""
     spec = P(DATA_AXIS, *([None] * (extra_dims - 1))) if extra_dims > 1 \
         else P(DATA_AXIS)
-    return jax.device_put(arr, NamedSharding(mesh, spec))
+    return put(mesh, arr, spec)
 
 
 def replicate(mesh: Mesh, arr):
-    return jax.device_put(arr, NamedSharding(mesh, P()))
+    return put(mesh, arr, P())

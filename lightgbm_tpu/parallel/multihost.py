@@ -6,30 +6,35 @@ Reference: the reference's distributed launch story — ``machine_list`` /
 UNVERIFIED — empty mount, see SURVEY.md banner).
 
 TPU-native replacement: ``jax.distributed.initialize`` IS the machine
-list. Each host process calls :func:`init_multihost` once before any
-device use; after that, ``jax.devices()`` spans the whole slice/pod, and
-every learner in this framework (data/voting/feature-parallel) runs
-unchanged — the ``Mesh`` simply contains remote devices, histogram
-reductions ride ICI within a slice and DCN across slices, exactly where
-the reference rides its socket ReduceScatter. There is no separate
-"dask" code path to maintain: sharded arrays + collectives are the
-transport.
+list. Each host process calls :func:`init_multihost` once — BEFORE any
+other JAX use — after which ``jax.devices()`` spans the whole slice/pod
+and ``create_data_mesh()`` builds the global mesh. The data placement
+layer (``parallel.mesh.put``) then assembles global arrays from
+per-process local chunks via ``jax.make_array_from_process_local_data``:
+each process constructs its ``Dataset`` from its OWN row shard (the
+reference's rank-aware ``pre_partition`` load, dataset_loader.cpp), and
+the SPMD learners consume the resulting global arrays. NOTE: binning
+must be consistent across processes — share the bin mappers (e.g.
+``Dataset.save_binary`` on rank 0, or identical
+``bin_construct_sample_cnt`` sampling of a common sample file).
 
-On Cloud TPU pods the coordinator/rank/process-count are discovered from
-the TPU metadata automatically (argument-free call); explicit arguments
-mirror the reference's machine_list semantics for other clusters.
+Validated in this repo on single-host (the driver's virtual 8-device
+mesh); the multi-host ingestion follows JAX's documented global-array
+recipe but has no multi-host CI here.
 """
 from __future__ import annotations
 
 from typing import Optional
 
 from ..utils import log
+from ..utils.log import LightGBMError
 
 
 def init_multihost(coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
                    process_id: Optional[int] = None) -> None:
-    """Join the multi-host training job (call once per host process).
+    """Join the multi-host training job (call once per host process,
+    before ANY other JAX use).
 
     Equivalent of the reference's ``machines=ip1:port,ip2:port`` +
     ``machine_list_file`` rank discovery: on TPU pods call with no
@@ -47,12 +52,12 @@ def init_multihost(coordinator_address: Optional[str] = None,
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        # the usual cause: some JAX computation (even device_count())
-        # already initialized the LOCAL backend
-        log.fatal(
-            f"init_multihost must be the FIRST JAX call in the process "
-            f"(before any Dataset/Booster construction, device queries, "
-            f"or is_multihost()): {e}")
+        raise LightGBMError(
+            f"jax.distributed.initialize failed: {e}. Common causes: "
+            f"JAX was already used in this process (init_multihost must "
+            f"be the first JAX call), initialize() was called twice, or "
+            f"the coordinator at {coordinator_address!r} is "
+            f"unreachable.") from e
     log.info(f"multi-host initialized: process {jax.process_index()} of "
              f"{jax.process_count()}, {jax.device_count()} global / "
              f"{jax.local_device_count()} local devices")
@@ -63,10 +68,3 @@ def is_multihost() -> bool:
     AFTER init_multihost (or in single-process jobs)."""
     import jax
     return jax.process_count() > 1
-
-
-def global_mesh():
-    """A 1-D data mesh over every device in the job (all hosts) — the
-    same construction the learners use."""
-    from .mesh import create_data_mesh
-    return create_data_mesh()

@@ -90,3 +90,70 @@ def test_explicit_mesh_subset():
     for _ in range(3):
         eng.train_one_iter()
     assert eng.num_trees() == 3
+
+
+def test_data_parallel_exact_with_precise_hist():
+    """With f32 histograms the psum/scatter reduction differs from the
+    serial sum only by float reduction order — predictions must agree to
+    tight tolerance, not the loose 5e-2 of the smoke test."""
+    X, y = _binary_data(n=2000, f=6, seed=11)
+    preds = {}
+    for learner in ("serial", "data"):
+        bst = lgb.train(
+            {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+             "tree_learner": learner, "min_data_in_leaf": 5,
+             "tpu_double_precision_hist": True},
+            lgb.Dataset(X, label=y), num_boost_round=10)
+        preds[learner] = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(preds["serial"], preds["data"],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_multiclass_under_data_parallel():
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(3000, 8))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    preds = {}
+    for learner in ("serial", "data"):
+        bst = lgb.train(
+            {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+             "verbosity": -1, "tree_learner": learner,
+             "tpu_double_precision_hist": True},
+            lgb.Dataset(X, label=y.astype(float)), num_boost_round=10)
+        preds[learner] = bst.predict(X)
+    acc_s = np.mean(np.argmax(preds["serial"], 1) == y)
+    acc_d = np.mean(np.argmax(preds["data"], 1) == y)
+    assert acc_d > 0.85
+    assert abs(acc_s - acc_d) < 0.01
+
+
+def test_lambdarank_under_data_parallel():
+    rng = np.random.default_rng(13)
+    n_q, per_q = 60, 20
+    X = rng.normal(size=(n_q * per_q, 6))
+    y = np.minimum(np.clip(X[:, 0] * 1.5
+                           + rng.normal(scale=0.4, size=len(X)),
+                           0, None).astype(int), 4)
+    group = np.full(n_q, per_q)
+    res = {}
+    ds = lgb.Dataset(X, label=y, group=group)
+    bst = lgb.train(
+        {"objective": "lambdarank", "num_leaves": 15, "metric": "ndcg",
+         "ndcg_eval_at": [5], "verbosity": -1, "tree_learner": "data"},
+        ds, num_boost_round=20,
+        valid_sets=[ds.create_valid(X, label=y, group=group)],
+        callbacks=[lgb.record_evaluation(res)])
+    assert res["valid_0"]["ndcg@5"][-1] > 0.75
+
+
+def test_goss_under_data_parallel():
+    X, y = _binary_data(n=4000, f=8, seed=14)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "tree_learner": "data", "data_sample_strategy": "goss",
+         "learning_rate": 0.2},
+        lgb.Dataset(X, label=y), num_boost_round=25)
+    from lightgbm_tpu.metric import AUCMetric
+    from lightgbm_tpu.config import Config
+    auc = AUCMetric(Config({})).eval(bst.predict(X), y, None)[0][1]
+    assert auc > 0.9

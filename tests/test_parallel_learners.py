@@ -120,8 +120,9 @@ def test_voting_with_categorical():
 
 def test_multihost_helpers_single_process():
     """Single-process degenerate behavior of the multi-host entry."""
-    from lightgbm_tpu.parallel.multihost import global_mesh, is_multihost
+    from lightgbm_tpu.parallel.mesh import create_data_mesh
+    from lightgbm_tpu.parallel.multihost import is_multihost
     assert is_multihost() is False
-    m = global_mesh()
+    m = create_data_mesh()
     assert m.devices.size == 8
     assert m.axis_names == ("data",)
