@@ -13,7 +13,7 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 from .config import Config
 from .engine import CVBooster, cv, train
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Booster", "Dataset", "LightGBMError", "Config",
@@ -24,8 +24,8 @@ __all__ = [
 
 
 def __getattr__(name):
-    # lazy submodule-level exports (sklearn API, plotting) to keep import
-    # light; mirrors python-package/lightgbm/__init__.py's surface
+    # lazy submodule-level exports (sklearn API, plotting, multi-host)
+    # to keep import light; mirrors python-package/lightgbm/__init__.py
     try:
         if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor",
                     "LGBMRanker"):
@@ -35,6 +35,9 @@ def __getattr__(name):
                     "create_tree_digraph"):
             from . import plotting as _pl
             return getattr(_pl, name)
+        if name in ("init_multihost", "is_multihost"):
+            from .parallel import multihost as _mh
+            return getattr(_mh, name)
     except ImportError as e:
         raise AttributeError(
             f"module 'lightgbm_tpu' has no attribute {name!r}: {e}") from e

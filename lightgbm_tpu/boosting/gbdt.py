@@ -141,6 +141,12 @@ class GBDT:
         self.learner_type = config.tree_learner if self.mesh is not None \
             else "serial"
         self._shard_features = self.learner_type == "feature"
+        if self._shard_features and jax.process_count() > 1:
+            # feature-sharded placement has no process-local chunk
+            # semantics (every process binned ALL columns); the
+            # row-sharded learners are the multi-host story
+            log.fatal("tree_learner=feature is not supported multi-host;"
+                      " use data or voting")
         self.axis = (self.mesh.axis_names[0]
                      if self.mesh is not None else "")
         self.objective: Objective = create_objective(config)

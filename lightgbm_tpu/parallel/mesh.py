@@ -66,15 +66,17 @@ def create_2d_mesh(data: int, feature: int) -> Mesh:
 def put(mesh: Mesh, arr, spec: P):
     """Place ``arr`` with the given spec. Under a MULTI-HOST mesh the
     array is assembled from per-process local chunks
-    (``jax.make_array_from_process_local_data``): for sharded specs each
-    process contributes its OWN row shard (the reference's rank-aware
-    ``pre_partition`` load, dataset_loader.cpp); for replicated specs
-    every process must pass identical data."""
+    (``jax.make_array_from_process_local_data``): for row-sharded specs
+    each process contributes its OWN row shard (the reference's
+    rank-aware ``pre_partition`` load, dataset_loader.cpp) and every
+    process must hold the SAME padded shard shape; for replicated specs
+    every process must pass identical data. Feature-sharded layouts
+    (feature-parallel) have no process-local semantics here — the
+    engine rejects that learner multi-host."""
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() > 1:
-        import numpy as _np
         return jax.make_array_from_process_local_data(
-            sharding, _np.asarray(arr))
+            sharding, np.asarray(arr))
     return jax.device_put(arr, sharding)
 
 
