@@ -1,0 +1,133 @@
+"""Probe: nibble-decomposed one-hot generation for the histogram kernel.
+
+The profiled floor of the Pallas histogram kernel is the [F*B, R] one-hot
+generation (docs/perf.md): repeat + int32 compare + astype(bf16) per row
+block, fused by Mosaic into the matmul operand but still ~3 VPU passes of
+F*B*R work. Idea: with b = NB*u + v,
+
+    onehot[(NB*u+v)*F + f, r] = (bins_hi[f,r] == u) * lo_arr[v*F+f, r]
+
+Unrolling u (B/NB steps): per step the lhs is repeat(hi_sel[F,R], NB) *
+lo_arr[NB*F, R] — ONE bf16 multiply pass over F*B*R total, plus
+(NB + B/NB)*F*R nibble compares (~12% of full-width compares at NB=16).
+"""
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time, functools
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lightgbm_tpu.ops.pallas_histogram import multi_leaf_histogram
+
+F, n, B, K, C = 28, 1_048_576, 256, 32, 3
+rng = np.random.default_rng(0)
+bins_np = rng.integers(0, 255, size=(F, n)).astype(np.int8)
+bins_t = jnp.asarray(bins_np)
+vals_t = jnp.asarray(rng.normal(size=(C, n)).astype(np.float32))
+leaf_id = jnp.asarray(rng.integers(0, K, size=n).astype(np.int32))
+small = jnp.arange(K, dtype=jnp.int32)
+
+
+def bench(fn, tag):
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(5):
+        out = fn()
+    jax.block_until_ready(out)
+    print(f"{tag}: {(time.time()-t0)/5*1000:.1f} ms/scan", flush=True)
+    return out
+
+
+def _nibble_kernel(bins_ref, vals_ref, leaf_ref, small_ref, out_ref, *,
+                   num_bins, n_feat, n_leaves, n_chan, nb):
+    i = pl.program_id(1)
+    bins_blk = bins_ref[...].astype(jnp.int32) & 0xFF    # [F, R]
+    vals_blk = vals_ref[...]
+    lid = leaf_ref[...]
+    sm = small_ref[...]
+    mask = (lid == sm).astype(jnp.float32)
+    rhs = (mask[:, None, :] * vals_blk[None, :, :]) \
+        .reshape(n_leaves * n_chan, -1).astype(jnp.bfloat16)
+
+    n_hi = num_bins // nb
+    hi_nib = bins_blk // nb                              # [F, R]
+    lo_nib = bins_blk - hi_nib * nb
+    lo_rep = pltpu.repeat(lo_nib, nb, axis=0)            # [nb*F, R]
+    iota_lo = (jax.lax.broadcasted_iota(jnp.int32, (nb * n_feat, 1), 0)
+               // n_feat)
+    lo_arr = (lo_rep == iota_lo).astype(jnp.bfloat16)    # [nb*F, R]
+
+    for u in range(n_hi):
+        hi_sel = (hi_nib == u).astype(jnp.bfloat16)      # [F, R]
+        oh_u = pltpu.repeat(hi_sel, nb, axis=0) * lo_arr
+        contrib = jax.lax.dot_general(
+            oh_u, rhs, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [nb*F, K*C]
+        sl = slice(u * nb * n_feat, (u + 1) * nb * n_feat)
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[sl, :] = contrib
+
+        @pl.when(i > 0)
+        def _():
+            out_ref[sl, :] += contrib
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "rows_per_block", "nb"))
+def hist_nibble(bins_t, vals_t, leaf_id, small_ids, *, num_bins,
+                rows_per_block=2048, nb=16):
+    F, n = bins_t.shape
+    C = vals_t.shape[0]
+    K = small_ids.shape[0]
+    R = rows_per_block
+    kernel = functools.partial(_nibble_kernel, num_bins=num_bins, n_feat=F,
+                               n_leaves=K, n_chan=C, nb=nb)
+    out = pl.pallas_call(
+        kernel,
+        grid=(1, n // R),
+        in_specs=[
+            pl.BlockSpec((F, R), lambda j, i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, R), lambda j, i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R), lambda j, i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, 1), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((num_bins * F, K * C), lambda j, i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((num_bins * F, K * C), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * F * num_bins * n * K * C,
+            bytes_accessed=bins_t.size + vals_t.size * 4 + leaf_id.size * 4,
+            transcendentals=0),
+    )(bins_t, vals_t, leaf_id.reshape(1, n), small_ids.reshape(K, 1))
+    out = out.reshape(num_bins, F, K, C)
+    return out.transpose(2, 1, 0, 3)
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices(), flush=True)
+    ref = bench(lambda: multi_leaf_histogram(
+        bins_t, vals_t, leaf_id, small, num_bins=B, rows_per_block=2048),
+        "current K=32 R=2048")
+    got = bench(lambda: hist_nibble(
+        bins_t, vals_t, leaf_id, small, num_bins=B, rows_per_block=2048),
+        "nibble16 K=32 R=2048")
+    err = float(jnp.max(jnp.abs(ref - got)))
+    print("max abs diff vs current:", err, flush=True)
+    for nb in (32, 64):
+        bench(lambda: hist_nibble(bins_t, vals_t, leaf_id, small,
+                                  num_bins=B, rows_per_block=2048, nb=nb),
+              f"nibble{nb} K=32 R=2048")
+    for R in (1024, 4096):
+        bench(lambda: hist_nibble(bins_t, vals_t, leaf_id, small,
+                                  num_bins=B, rows_per_block=R),
+              f"nibble16 K=32 R={R}")

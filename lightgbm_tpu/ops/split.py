@@ -27,6 +27,29 @@ import jax.numpy as jnp
 NEG_INF = jnp.float32(-jnp.inf)
 
 
+def _cumsum_bins(hist_vals: jax.Array) -> jax.Array:
+    """Inclusive cumsum over the bin axis of ``[F, B, C]`` as a
+    triangular-matrix product. XLA lowers ``jnp.cumsum`` to a VPU
+    reduce-window (~10 ms per 64-child round at B=256 on v5e); the same
+    O(F*B^2*C) MACs ride the MXU in microseconds. Counts stay exact:
+    they are integers < 2^24, and 0/1-weighted f32 sums of such values
+    are exact in any summation order at HIGHEST precision.
+
+    TPU-only: the matmul trades O(F*B*C) adds for O(F*B^2*C) MACs — a
+    win only where the MXU makes MACs ~free. The CPU/XLA path (and the
+    B > 512 wide-histogram route) keeps ``jnp.cumsum``."""
+    f, b, c = hist_vals.shape
+    if jax.default_backend() != "tpu" or b > 512:
+        return jnp.cumsum(hist_vals, axis=1)
+    tri = (jnp.arange(b, dtype=jnp.int32)[:, None]
+           <= jnp.arange(b, dtype=jnp.int32)[None, :])
+    cum = jax.lax.dot_general(
+        hist_vals, tri.astype(hist_vals.dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)       # [F, C, B]
+    return cum.transpose(0, 2, 1)
+
+
 @dataclasses.dataclass(frozen=True)
 class SplitConfig:
     """Static split-search hyperparameters (subset of Config)."""
@@ -258,7 +281,7 @@ def _numerical_candidates(hist, parent_sums, num_bin, has_nan,
     hist_vals = jnp.where(is_nan_bin[..., None], 0.0, hist)
     nan_sums = jnp.sum(jnp.where(is_nan_bin[..., None], hist, 0.0),
                        axis=1)                                 # [F, 3]
-    cum = jnp.cumsum(hist_vals, axis=1)                        # [F, B, 3]
+    cum = _cumsum_bins(hist_vals)                              # [F, B, 3]
     parent = parent_sums[None, None, :]
 
     # direction 0: missing goes right; direction 1: missing goes left
