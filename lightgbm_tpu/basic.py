@@ -372,7 +372,12 @@ class Booster:
             else:
                 grad_point = score
             sc = jnp.asarray(grad_point[:, 0] if K == 1 else grad_point)
-            if getattr(obj, "needs_rng", False):
+            if getattr(obj, "has_pos_state", False):
+                # refit with neutral propensities (pos_state=None): the
+                # training-time bias state is not serialized with the
+                # model
+                g, h, _ = obj.get_gradients(sc, label_dev, w_dev)
+            elif getattr(obj, "needs_rng", False):
                 g, h = obj.get_gradients(sc, label_dev, w_dev,
                                          key=jax.random.PRNGKey(it))
             else:

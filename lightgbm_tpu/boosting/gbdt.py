@@ -158,6 +158,16 @@ class GBDT:
             self.objective.setup_queries(
                 self.train_set.metadata.query_boundaries,
                 self.train_set.num_data)
+        # stateful objectives (lambdarank_unbiased): per-rank propensity
+        # state threads through the boosting step and updates host-side
+        # each iteration (not rolled back by rollback_one_iter)
+        self._pos_state = None
+        if getattr(self.objective, "has_pos_state", False):
+            if self.mesh is not None:
+                log.fatal("lambdarank_unbiased is not supported with "
+                          "distributed tree_learner yet; use the serial "
+                          "learner or disable lambdarank_unbiased")
+            self._pos_state = self.objective.init_pos_state()
         self.metrics: List[Metric] = metrics_for_config(config)
         self.num_class = config.num_tree_per_iteration
         self.models: List[Tree] = []
@@ -509,6 +519,7 @@ class GBDT:
         mesh = self.mesh
 
         needs_rng = getattr(obj, "needs_rng", False)
+        self._step_state = self._step_goss_state = None
 
         def gradients(score, label, weight, key):
             s = score[:, 0] if K == 1 else score
@@ -713,6 +724,42 @@ class GBDT:
                 return step_custom_impl(d.bins, d.bins_t, score, g, h,
                                         mask_gh, mask_count, allowed,
                                         cegb_pen, key)
+
+            if getattr(obj, "has_pos_state", False):
+                # stateful objective: gradients also return updated
+                # position-bias state, threaded by train_one_iter
+                def grads_state(score, pos_state):
+                    s = score[:, 0] if K == 1 else score
+                    return obj.get_gradients(s, d.label, d.weight,
+                                             pos_state=pos_state)
+
+                @jax.jit
+                def step_state(score, mask_gh, mask_count, allowed,
+                               cegb_pen, key, pos_state):
+                    g, h, new_state = grads_state(score, pos_state)
+                    stacked, lids, ns = grow_all(
+                        d.bins, d.bins_t, score, g, h, mask_gh,
+                        mask_count, allowed,
+                        qkey=jax.random.fold_in(key, 0x9e37),
+                        cegb_pen=cegb_pen)
+                    return stacked, lids, ns, new_state
+
+                @jax.jit
+                def step_goss_state(score, allowed, cegb_pen, key,
+                                    pos_state):
+                    kg, km = jax.random.split(key)
+                    g, h, new_state = grads_state(score, pos_state)
+                    mask_gh, mask_count = goss_masks(g, h, d.valid_mask,
+                                                     km)
+                    stacked, lids, ns = grow_all(
+                        d.bins, d.bins_t, score, g, h, mask_gh,
+                        mask_count, allowed,
+                        qkey=jax.random.fold_in(key, 0x9e37),
+                        cegb_pen=cegb_pen)
+                    return stacked, lids, ns, new_state
+
+                self._step_state = step_state
+                self._step_goss_state = step_goss_state
 
             valid_update = plain_valid_update
         else:
@@ -950,13 +997,25 @@ class GBDT:
                 self.score, g, h, mask_gh, mask_count, allowed,
                 self._cegb_pen(), key)
         elif goss_active:
-            stacked, leaf_ids, new_score = self._step_goss(
-                self.score, allowed, self._cegb_pen(), key)
+            if self._pos_state is not None:
+                stacked, leaf_ids, new_score, self._pos_state = \
+                    self._step_goss_state(self.score, allowed,
+                                          self._cegb_pen(), key,
+                                          self._pos_state)
+            else:
+                stacked, leaf_ids, new_score = self._step_goss(
+                    self.score, allowed, self._cegb_pen(), key)
         else:
             mask_gh, mask_count = self._bagging_masks()
-            stacked, leaf_ids, new_score = self._step(
-                self.score, mask_gh, mask_count, allowed,
-                self._cegb_pen(), key)
+            if self._pos_state is not None:
+                stacked, leaf_ids, new_score, self._pos_state = \
+                    self._step_state(self.score, mask_gh, mask_count,
+                                     allowed, self._cegb_pen(), key,
+                                     self._pos_state)
+            else:
+                stacked, leaf_ids, new_score = self._step(
+                    self.score, mask_gh, mask_count, allowed,
+                    self._cegb_pen(), key)
         # start device->host copies of the (tiny) tree arrays immediately:
         # over a tunneled TPU each sync transfer is a latency round-trip,
         # so issue them all async and overlap with the step itself
@@ -1018,7 +1077,13 @@ class GBDT:
         label = jnp.asarray(self.train_set.metadata.label)
         w = self.train_set.metadata.weight
         w = None if w is None else jnp.asarray(w)
-        if getattr(self.objective, "needs_rng", False):
+        if getattr(self.objective, "has_pos_state", False):
+            # post-update state (the pre-update state is gone by now);
+            # the propensity drift between two iterations is negligible
+            # for the leaf refit
+            g, h, _ = self.objective.get_gradients(
+                sc, label, w, pos_state=self._pos_state)
+        elif getattr(self.objective, "needs_rng", False):
             # the SAME key the grown tree's gradients used
             g, h = self.objective.get_gradients(
                 sc, label, w, key=jax.random.PRNGKey(
@@ -1102,7 +1167,7 @@ class GBDT:
         return (self.fobj is None and not renews and not use_bagging
                 and c.feature_fraction >= 1.0 and not self.valid_data
                 and self._cegb_coupled is None and not self.linear_tree
-                and not c.tpu_debug_checks)
+                and not c.tpu_debug_checks and self._pos_state is None)
 
     def train_chunk(self, n_iters: int) -> None:
         """Run ``n_iters`` boosting iterations in one device dispatch

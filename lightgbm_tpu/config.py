@@ -184,6 +184,10 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "lambdarank_truncation_level": _P("int", 30, [], (1, None)),
     "lambdarank_norm": _P("bool", True),
     "label_gain": _P("float_list", []),
+    # unbiased LambdaRank (rank_objective.hpp lambdarank_unbiased):
+    # learn per-rank click-propensity corrections from pairwise costs
+    "lambdarank_unbiased": _P("bool", False),
+    "lambdarank_bias_p_norm": _P("float", 0.5, [], (0.0, None)),
     "lambdarank_position_bias_regularization": _P("float", 0.0, [],
                                                   (0.0, None)),
     # ---- Metric parameters -----------------------------------------------
@@ -248,6 +252,10 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # per-iteration finite checks on tree outputs/scores (the aux
     # NaN-guard subsystem; costs a host sync per iteration)
     "tpu_debug_checks": _P("bool", False),
+    # when set, wrap training in a jax.profiler trace (view with
+    # TensorBoard / xprof) — the §5 tracing subsystem; the reference's
+    # analog is the global function timers + GPU_DEBUG timing
+    "tpu_profile_dir": _P("str", ""),
     # leaf-histogram storage: "pool" keeps the [L+1, F, B, 3] carry and
     # derives siblings by subtraction (the reference's HistogramPool);
     # "rebuild" computes BOTH children per round in one scan — the masks

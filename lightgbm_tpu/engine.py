@@ -91,57 +91,65 @@ def train(params: Dict[str, Any], train_set: Dataset,
     train_as_valid = valid_sets and any(vs is train_set
                                         for vs in valid_sets)
 
-    # fused fast path: with no per-iteration host work (callbacks, eval,
-    # custom fobj), run the whole training as chunked device dispatches —
-    # identical models, one dispatch per tpu_fuse_iters iterations
-    if (not callbacks_before and not callbacks_after and not valid_sets
-            and not cfg.is_provide_training_metric and fobj is None
-            and cfg.tpu_fuse_iters > 1 and cfg.snapshot_freq <= 0
-            and booster.engine.can_fuse_iters()):
-        with timed("boosting (fused chunks)"):
-            booster.engine.train_chunk(num_boost_round)
-        booster.best_iteration = booster.current_iteration()
+    # optional jax.profiler trace around the whole boosting run
+    # (tpu_profile_dir; SURVEY.md §5 tracing subsystem)
+    import contextlib
+    with contextlib.ExitStack() as _prof_stack:
+        if cfg.tpu_profile_dir:
+            import jax
+            jax.profiler.start_trace(cfg.tpu_profile_dir)
+            _prof_stack.callback(jax.profiler.stop_trace)
+        # fused fast path: with no per-iteration host work (callbacks, eval,
+        # custom fobj), run the whole training as chunked device dispatches —
+        # identical models, one dispatch per tpu_fuse_iters iterations
+        if (not callbacks_before and not callbacks_after and not valid_sets
+                and not cfg.is_provide_training_metric and fobj is None
+                and cfg.tpu_fuse_iters > 1 and cfg.snapshot_freq <= 0
+                and booster.engine.can_fuse_iters()):
+            with timed("boosting (fused chunks)"):
+                booster.engine.train_chunk(num_boost_round)
+            booster.best_iteration = booster.current_iteration()
+            log_timers()
+            return booster
+
+        for it in range(num_boost_round):
+            env_pre = callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=it,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None)
+            for cb in callbacks_before:
+                cb(env_pre)
+            with timed("boosting (per-iter)"):
+                booster.update(fobj=fobj)
+            if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
+                # mid-training checkpoint (Application snapshot_freq semantics)
+                booster.save_model(
+                    f"{cfg.output_model}.snapshot_iter_{it + 1}")
+
+            eval_results = []
+            should_eval = ((booster.engine.valid_data or train_as_valid
+                            or cfg.is_provide_training_metric)
+                           and (it + 1) % cfg.metric_freq == 0)
+            if should_eval:
+                if cfg.is_provide_training_metric or train_as_valid:
+                    eval_results.extend(booster.eval_train(feval))
+                eval_results.extend(booster.eval_valid(feval))
+            env = callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=it,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=eval_results)
+            try:
+                for cb in callbacks_after:
+                    cb(env)
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for name, metric, value, _ in (e.best_score or []):
+                    booster.best_score.setdefault(name, {})[metric] = value
+                break
+        if booster.best_iteration < 0:
+            booster.best_iteration = booster.current_iteration()
         log_timers()
         return booster
-
-    for it in range(num_boost_round):
-        env_pre = callback_mod.CallbackEnv(
-            model=booster, params=params, iteration=it,
-            begin_iteration=0, end_iteration=num_boost_round,
-            evaluation_result_list=None)
-        for cb in callbacks_before:
-            cb(env_pre)
-        with timed("boosting (per-iter)"):
-            booster.update(fobj=fobj)
-        if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
-            # mid-training checkpoint (Application snapshot_freq semantics)
-            booster.save_model(
-                f"{cfg.output_model}.snapshot_iter_{it + 1}")
-
-        eval_results = []
-        should_eval = ((booster.engine.valid_data or train_as_valid
-                        or cfg.is_provide_training_metric)
-                       and (it + 1) % cfg.metric_freq == 0)
-        if should_eval:
-            if cfg.is_provide_training_metric or train_as_valid:
-                eval_results.extend(booster.eval_train(feval))
-            eval_results.extend(booster.eval_valid(feval))
-        env = callback_mod.CallbackEnv(
-            model=booster, params=params, iteration=it,
-            begin_iteration=0, end_iteration=num_boost_round,
-            evaluation_result_list=eval_results)
-        try:
-            for cb in callbacks_after:
-                cb(env)
-        except callback_mod.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for name, metric, value, _ in (e.best_score or []):
-                booster.best_score.setdefault(name, {})[metric] = value
-            break
-    if booster.best_iteration < 0:
-        booster.best_iteration = booster.current_iteration()
-    log_timers()
-    return booster
 
 
 class CVBooster:

@@ -303,19 +303,29 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
     """
     n_rows, n_features = X.shape
     categorical = set(categorical_features or [])
+    # scipy sparse accepted without densifying the full matrix: rows are
+    # sampled in CSR, then one column at a time is materialized (the
+    # reference's sparse sample path, dataset_loader.cpp SampleData)
+    is_sparse = hasattr(X, "tocsr") and not isinstance(X, np.ndarray)
     if n_rows > sample_cnt:
         rng = np.random.default_rng(seed)
-        idx = rng.choice(n_rows, size=sample_cnt, replace=False)
-        sample = X[np.sort(idx)]
+        idx = np.sort(rng.choice(n_rows, size=sample_cnt, replace=False))
+        sample = (X.tocsr()[idx] if is_sparse else X[idx])
     else:
         sample = X
+    if is_sparse:
+        sample = sample.tocsc()
+    n_sample = sample.shape[0]
     mappers = []
     for f in range(n_features):
         mb = max_bin
         if max_bin_by_feature and f < len(max_bin_by_feature) \
                 and max_bin_by_feature[f] > 0:
             mb = max_bin_by_feature[f]
+        col = sample[:, f]
+        if is_sparse:
+            col = np.asarray(col.todense(), dtype=np.float64).ravel()
         mappers.append(BinMapper.from_sample(
-            sample[:, f], len(sample), mb, min_data_in_bin, use_missing,
+            col, n_sample, mb, min_data_in_bin, use_missing,
             zero_as_missing, is_categorical=(f in categorical)))
     return mappers

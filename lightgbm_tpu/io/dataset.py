@@ -21,6 +21,16 @@ from ..utils import log
 from .binning import BIN_TYPE_CATEGORICAL, BinMapper, find_bin_mappers
 
 
+def _coerce_1d(a) -> np.ndarray:
+    """1-D float64 coercion accepting numpy / lists / pandas Series /
+    pyarrow Array-ChunkedArray (np.asarray would wrap arrow objects as
+    dtype=object)."""
+    if hasattr(a, "to_numpy") and \
+            (type(a).__module__ or "").startswith("pyarrow"):
+        a = a.to_numpy(zero_copy_only=False)
+    return np.asarray(a, dtype=np.float64)
+
+
 @dataclasses.dataclass
 class Metadata:
     """Per-row training metadata (reference: Metadata, metadata.cpp)."""
@@ -69,15 +79,13 @@ class Dataset:
         self.categorical_feature = categorical_feature
         self.metadata = Metadata()
         if label is not None:
-            self.metadata.label = np.asarray(label, dtype=np.float64).ravel()
+            self.metadata.label = _coerce_1d(label).ravel()
         if weight is not None:
-            self.metadata.weight = np.asarray(weight,
-                                              dtype=np.float64).ravel()
+            self.metadata.weight = _coerce_1d(weight).ravel()
         if group is not None:
-            self.metadata.set_group(np.asarray(group))
+            self.metadata.set_group(_coerce_1d(group))
         if init_score is not None:
-            self.metadata.init_score = np.asarray(init_score,
-                                                  dtype=np.float64)
+            self.metadata.init_score = _coerce_1d(init_score)
         # filled by construct()
         self._constructed = False
         self.bin_mappers: List[BinMapper] = []
@@ -93,9 +101,20 @@ class Dataset:
     # ------------------------------------------------------------------
     @staticmethod
     def _to_matrix(data) -> np.ndarray:
-        """Accept numpy / pandas / list-of-lists / scipy-sparse."""
+        """Accept numpy / pandas / pyarrow / list-of-lists / scipy-sparse.
+
+        Reference: LGBM_DatasetCreateFromMat/CSR/CSC/Arrow (c_api.cpp,
+        UNVERIFIED — empty mount); the arrow path mirrors basic.py's
+        pyarrow Table handling."""
         if hasattr(data, "toarray"):          # scipy sparse
             return np.asarray(data.toarray(), dtype=np.float64)
+        if (type(data).__module__ or "").startswith("pyarrow") \
+                and hasattr(data, "column_names"):   # pyarrow.Table
+            cols = [np.asarray(data.column(i).to_numpy(
+                zero_copy_only=False), dtype=np.float64)
+                for i in range(data.num_columns)]
+            return np.stack(cols, axis=1) if cols else \
+                np.zeros((0, 0), np.float64)
         if hasattr(data, "values") and hasattr(data, "columns"):  # pandas
             return np.asarray(data.values, dtype=np.float64)
         arr = np.asarray(data, dtype=np.float64)
@@ -106,6 +125,9 @@ class Dataset:
     def _resolve_feature_names(self, n_features: int) -> List[str]:
         if isinstance(self.feature_name, list):
             return list(self.feature_name)
+        if hasattr(self.data, "column_names"):    # pyarrow (checked
+            # first: arrow Tables also expose a `.columns` of arrays)
+            return [str(c) for c in self.data.column_names]
         if hasattr(self.data, "columns"):     # pandas
             return [str(c) for c in self.data.columns]
         return [f"Column_{i}" for i in range(n_features)]
@@ -133,8 +155,20 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
-        X = self._to_matrix(self.data)
-        self.num_data, self.num_total_features = X.shape
+        # scipy sparse binning never densifies the raw matrix (8 bytes x
+        # n x F would dwarf the uint8 binned output at Criteo-class
+        # sparsity); one float64 column is materialized at a time from
+        # CSC (LGBM_DatasetCreateFromCSC, c_api.cpp — UNVERIFIED)
+        is_sparse = (hasattr(self.data, "tocsc")
+                     and hasattr(self.data, "nnz")
+                     and not isinstance(self.data, np.ndarray))
+        if is_sparse:
+            Xc = self.data.tocsc()
+            X = Xc          # find_bin_mappers handles sparse natively
+            self.num_data, self.num_total_features = Xc.shape
+        else:
+            X = self._to_matrix(self.data)
+            self.num_data, self.num_total_features = X.shape
         if self.metadata.label is not None \
                 and len(self.metadata.label) != self.num_data:
             log.fatal(f"Length of label ({len(self.metadata.label)}) does "
@@ -182,12 +216,21 @@ class Dataset:
         dtype = np.uint8 if max_num_bin <= 256 else np.uint16
         cols = []
         for f in self.used_features:
-            cols.append(self.bin_mappers[f].values_to_bins(X[:, f])
+            if is_sparse:
+                colv = np.zeros(self.num_data, np.float64)
+                sl = slice(Xc.indptr[f], Xc.indptr[f + 1])
+                colv[Xc.indices[sl]] = Xc.data[sl]
+            else:
+                colv = X[:, f]
+            cols.append(self.bin_mappers[f].values_to_bins(colv)
                         .astype(dtype))
         self.binned = (np.stack(cols, axis=1) if cols
                        else np.zeros((self.num_data, 0), dtype=dtype))
         from ..config import coerce_bool as _cb
         if _cb(self.params.get("linear_tree", False)):
+            if is_sparse:
+                log.fatal("linear_tree requires dense input data (leaf "
+                          "ridge fits read raw feature values)")
             self._raw_for_linear = X[:, self.used_features].copy()
         self._constructed = True
         if self.free_raw_data:
@@ -202,21 +245,22 @@ class Dataset:
                        free_raw_data=self.free_raw_data)
 
     def set_label(self, label) -> "Dataset":
-        self.metadata.label = np.asarray(label, dtype=np.float64).ravel()
+        self.metadata.label = _coerce_1d(label).ravel()
         return self
 
     def set_weight(self, weight) -> "Dataset":
         self.metadata.weight = (None if weight is None else
-                                np.asarray(weight, dtype=np.float64).ravel())
+                                _coerce_1d(weight).ravel())
         return self
 
     def set_group(self, group) -> "Dataset":
-        self.metadata.set_group(None if group is None else np.asarray(group))
+        self.metadata.set_group(None if group is None
+                                else _coerce_1d(group))
         return self
 
     def set_init_score(self, init_score) -> "Dataset":
         self.metadata.init_score = (None if init_score is None else
-                                    np.asarray(init_score, dtype=np.float64))
+                                    _coerce_1d(init_score))
         return self
 
     def set_field(self, field_name: str, data) -> "Dataset":
@@ -329,6 +373,10 @@ class Dataset:
     def __len__(self) -> int:
         if self._constructed:
             return self.num_data
+        if hasattr(self.data, "shape"):   # ndarray/scipy/pandas — no
+            return int(self.data.shape[0])  # densifying coercion
+        if hasattr(self.data, "num_rows"):  # pyarrow
+            return int(self.data.num_rows)
         return len(self._to_matrix(self.data))
 
     # ------------------------------------------------------------------

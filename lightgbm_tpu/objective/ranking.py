@@ -69,6 +69,25 @@ class LambdaRank(_RankingBase):
         self.sigmoid = config.sigmoid
         self.truncation = config.lambdarank_truncation_level
         self.norm = config.lambdarank_norm
+        # unbiased LambdaRank (rank_objective.hpp `lambdarank_unbiased`,
+        # UNVERIFIED — empty mount; formulation follows Unbiased
+        # LambdaMART, Hu et al. 2019): per-RANK propensity corrections
+        # t+ (clicked/high side) and t- (unclicked/low side), estimated
+        # each iteration from the accumulated pairwise logistic costs and
+        # applied as 1/(t_i+ * t_j-) pair weights. State threads through
+        # the boosting step (has_pos_state protocol in boosting/gbdt.py).
+        self.unbiased = bool(getattr(config, "lambdarank_unbiased", False))
+        self.has_pos_state = self.unbiased
+        self.bias_p_norm = float(getattr(config, "lambdarank_bias_p_norm",
+                                         0.5))
+        self.bias_reg = float(getattr(
+            config, "lambdarank_position_bias_regularization", 0.0))
+
+    def init_pos_state(self):
+        """Initial per-rank propensities: all ones ([2, M] — row 0 = t+
+        indexed by the HIGH doc's score rank, row 1 = t-)."""
+        M = self._qidx.shape[1]
+        return jnp.ones((2, M), jnp.float32)
 
     def prepare(self, label: np.ndarray, weight) -> None:
         max_label = int(label.max())
@@ -79,13 +98,19 @@ class LambdaRank(_RankingBase):
         self._gains_np = gains
         self._label_gain_table = jnp.asarray(gains, jnp.float32)
 
-    def get_gradients(self, score, label, weight):
+    def get_gradients(self, score, label, weight, pos_state=None):
         if self._qidx is None:
             log.fatal("setup_queries was not called for lambdarank")
         Q, M = self._qidx.shape
         T = min(self.truncation, M)
         sig = self.sigmoid
         gains_tbl = self._label_gain_table
+        unbiased = self.unbiased
+        if unbiased:
+            bias_hi = (pos_state[0] if pos_state is not None
+                       else jnp.ones(M, jnp.float32))
+            bias_lo = (pos_state[1] if pos_state is not None
+                       else jnp.ones(M, jnp.float32))
 
         s = jnp.where(self._qmask, self._gather_queries(score), -jnp.inf)
         y = jnp.where(self._qmask,
@@ -130,6 +155,29 @@ class LambdaRank(_RankingBase):
             hess_pair = sig * sig * rho * (1.0 - rho) * delta
             lam = jnp.where(pair_ok, lam, 0.0)
             hess_pair = jnp.where(pair_ok, hess_pair, 0.0)
+            if unbiased:
+                # score rank of the high/low doc of each pair
+                ri = jnp.arange(T, dtype=jnp.int32)[:, None]
+                rj = jnp.arange(M, dtype=jnp.int32)[None, :]
+                rank_h = jnp.where(i_is_high, ri, rj)       # [T, M]
+                rank_l = jnp.where(i_is_high, rj, ri)
+                t_hi = bias_hi[rank_h]
+                t_lo = bias_lo[rank_l]
+                # pairwise logistic cost at the CURRENT model, weighted
+                # like the lambdas; each side's accumulator divides by
+                # the OTHER side's propensity (Hu et al. eq. 14/15)
+                p_cost = jnp.where(
+                    pair_ok,
+                    -jnp.log(jnp.maximum(1.0 - rho, 1e-20)) * delta, 0.0)
+                cost_hi_q = jnp.zeros(M, jnp.float32).at[rank_h].add(
+                    p_cost / t_lo)
+                cost_lo_q = jnp.zeros(M, jnp.float32).at[rank_l].add(
+                    p_cost / t_hi)
+                inv_w = 1.0 / (t_hi * t_lo)
+                lam = lam * inv_w
+                hess_pair = hess_pair * inv_w
+            else:
+                cost_hi_q = cost_lo_q = jnp.zeros(M, jnp.float32)
 
             # accumulate: high doc gets -lam, low doc gets +lam
             lam_i = jnp.where(i_is_high, -lam, lam)         # [T, M]
@@ -151,9 +199,10 @@ class LambdaRank(_RankingBase):
             # undo the sort
             grad_q = jnp.zeros(M, jnp.float32).at[order].set(grad_sorted)
             hess_q = jnp.zeros(M, jnp.float32).at[order].set(hess_sorted)
-            return grad_q, hess_q
+            return grad_q, hess_q, cost_hi_q, cost_lo_q
 
-        grad_q, hess_q = jax.vmap(per_query)(s, y, self._qmask)
+        grad_q, hess_q, cost_hi, cost_lo = jax.vmap(per_query)(
+            s, y, self._qmask)
 
         grad = jnp.zeros(score.shape[0], jnp.float32)
         hess = jnp.zeros(score.shape[0], jnp.float32)
@@ -165,7 +214,25 @@ class LambdaRank(_RankingBase):
         if weight is not None:
             grad = grad * weight
             hess = hess * weight
-        return grad, hess
+        if not unbiased:
+            return grad, hess
+        # ---- propensity update: t[r] = (C[r] / C[0])^p, shrunk toward
+        # 1 by the regularization term (reference constants UNVERIFIED —
+        # empty mount; p_norm=0 makes this an exact no-op, pinned by
+        # tests/test_ranking_unbiased.py) --------------------------------
+        chi = jnp.sum(cost_hi, axis=0)                     # [M]
+        clo = jnp.sum(cost_lo, axis=0)
+
+        def propensity(c):
+            c0 = jnp.maximum(c[0], 1e-20)
+            ratio = jnp.maximum(c / c0, 1e-6)
+            t = ratio ** self.bias_p_norm
+            t = (t + self.bias_reg) / (1.0 + self.bias_reg)
+            # ranks that saw no pairs keep their neutral propensity
+            return jnp.where(c > 0, jnp.maximum(t, 1e-3), 1.0)
+
+        new_state = jnp.stack([propensity(chi), propensity(clo)])
+        return grad, hess, new_state
 
 
 class RankXENDCG(_RankingBase):

@@ -1,0 +1,89 @@
+"""Unbiased LambdaRank (position-bias debiasing).
+
+Reference: rank_objective.hpp ``lambdarank_unbiased`` (UNVERIFIED — empty
+mount); formulation follows Unbiased LambdaMART (Hu et al. 2019):
+per-rank propensities estimated from accumulated pairwise costs, applied
+as 1/(t+ * t-) pair weights. ``lambdarank_bias_p_norm=0`` makes the
+correction an exact no-op, which pins the plumbing end-to-end.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _rank_data(seed=0, n_query=40, per_q=24):
+    rng = np.random.default_rng(seed)
+    n = n_query * per_q
+    X = rng.normal(size=(n, 6))
+    rel = np.clip((X[:, 0] + 0.5 * X[:, 1]
+                   + rng.normal(scale=0.5, size=n)) * 1.2 + 1.5,
+                  0, 4).astype(int).astype(float)
+    group = np.full(n_query, per_q)
+    return X, rel, group
+
+
+def _train(params, n_iter=8):
+    X, y, group = _rank_data()
+    ds = lgb.Dataset(X, label=y, group=group)
+    base = {"objective": "lambdarank", "num_leaves": 15,
+            "min_data_in_leaf": 5, "verbosity": -1}
+    base.update(params)
+    return lgb.train(base, ds, num_boost_round=n_iter), X
+
+
+def test_p_norm_zero_is_exact_noop():
+    bst_plain, X = _train({})
+    bst_un, _ = _train({"lambdarank_unbiased": True,
+                        "lambdarank_bias_p_norm": 0.0})
+    np.testing.assert_allclose(bst_plain.predict(X), bst_un.predict(X),
+                               rtol=0, atol=0)
+
+
+def test_unbiased_trains_and_learns_propensities():
+    X, y, group = _rank_data(seed=1)
+    ds = lgb.Dataset(X, label=y, group=group)
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    cfg = Config({"objective": "lambdarank", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "verbosity": -1,
+                  "lambdarank_unbiased": True})
+    eng = GBDT(cfg, ds)
+    assert eng._pos_state is not None
+    assert eng._pos_state.shape == (2, 24)
+    for _ in range(6):
+        eng.train_one_iter()
+    st = np.asarray(eng._pos_state)
+    assert np.isfinite(st).all()
+    assert (st > 0).all()
+    # rank 0 is the normalization anchor; later ranks saw pairs and
+    # must have moved off the neutral initialization. The high-side
+    # propensity t+ decays with rank (harder to "click" far down) while
+    # the low-side ratio may exceed 1 — only anchoring is guaranteed.
+    np.testing.assert_allclose(st[:, 0], 1.0, atol=1e-6)
+    assert st[0, 1:8].min() < 0.999
+    assert st[0, 1] > st[0, 12]      # t+ decreasing overall
+    # the model still ranks: predictions correlate with relevance
+    pred = eng.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.3
+
+
+def test_unbiased_under_goss():
+    bst, X = _train({"lambdarank_unbiased": True,
+                     "data_sample_strategy": "goss",
+                     "learning_rate": 0.5, "top_rate": 0.3,
+                     "other_rate": 0.2}, n_iter=6)
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_unbiased_rejects_distributed():
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    X, y, group = _rank_data()
+    ds = lgb.Dataset(X, label=y, group=group)
+    with pytest.raises(lgb.LightGBMError, match="lambdarank_unbiased"):
+        lgb.train({"objective": "lambdarank", "tree_learner": "data",
+                   "num_machines": 2, "lambdarank_unbiased": True,
+                   "verbosity": -1, "num_leaves": 15}, ds,
+                  num_boost_round=2)
