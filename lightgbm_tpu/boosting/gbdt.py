@@ -157,7 +157,8 @@ class GBDT:
         if self.objective.is_ranking:
             self.objective.setup_queries(
                 self.train_set.metadata.query_boundaries,
-                self.train_set.num_data)
+                self.train_set.num_data,
+                position=self.train_set.metadata.position)
         # stateful objectives (lambdarank_unbiased): per-rank propensity
         # state threads through the boosting step and updates host-side
         # each iteration (not rolled back by rollback_one_iter)

@@ -41,6 +41,9 @@ class Metadata:
     # the reference's query_boundaries_ built from per-query counts
     query_boundaries: Optional[np.ndarray] = None
     init_score: Optional[np.ndarray] = None
+    # per-row presentation positions (Metadata::positions, v4.2+):
+    # consumed by lambdarank_unbiased instead of the score rank
+    position: Optional[np.ndarray] = None
 
     def set_group(self, group: Optional[np.ndarray]) -> None:
         if group is None:
@@ -155,6 +158,8 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
+        if self._finish_pushed():
+            return self
         # scipy sparse binning never densifies the raw matrix (8 bytes x
         # n x F would dwarf the uint8 binned output at Criteo-class
         # sparsity); one float64 column is materialized at a time from
@@ -244,6 +249,76 @@ class Dataset:
                        group=group, init_score=init_score, params=params,
                        free_raw_data=self.free_raw_data)
 
+    # ------------------------------------------------------------------
+    def push_rows(self, chunk, label=None, weight=None) -> "Dataset":
+        """Streaming row ingestion (LGBM_DatasetPushRows / the streaming
+        C API seam, c_api.cpp — UNVERIFIED). Build with ``Dataset(None,
+        reference=...)`` and push row chunks; with a reference whose bin
+        mappers exist, each chunk is binned IMMEDIATELY and the raw
+        floats are dropped (true streaming memory behavior). Without a
+        reference, raw chunks accumulate until ``construct`` samples
+        them for binning."""
+        if self._constructed:
+            log.fatal("push_rows after construct()")
+        if self.data is not None:
+            log.fatal("push_rows requires Dataset(None, ...)")
+        chunk = self._to_matrix(chunk)
+        if not hasattr(self, "_pushed"):
+            self._pushed, self._pushed_meta = [], {"label": [],
+                                                   "weight": []}
+        if self.reference is not None:
+            ref = self.reference.construct()
+            if chunk.shape[1] != ref.num_total_features:
+                log.fatal(f"pushed chunk has {chunk.shape[1]} features, "
+                          f"reference has {ref.num_total_features}")
+            dtype = ref.binned.dtype
+            cols = [ref.bin_mappers[f].values_to_bins(chunk[:, f])
+                    .astype(dtype) for f in ref.used_features]
+            self._pushed.append(
+                np.stack(cols, axis=1) if cols
+                else np.zeros((len(chunk), 0), dtype))
+        else:
+            self._pushed.append(chunk)
+        if label is not None:
+            self._pushed_meta["label"].append(_coerce_1d(label).ravel())
+        if weight is not None:
+            self._pushed_meta["weight"].append(_coerce_1d(weight).ravel())
+        return self
+
+    def _finish_pushed(self) -> bool:
+        """Finalize streamed rows at construct time; True if handled
+        fully (reference path: chunks are already binned)."""
+        if not getattr(self, "_pushed", None):
+            return False
+        if self._pushed_meta["label"]:
+            self.metadata.label = np.concatenate(
+                self._pushed_meta["label"])
+        if self._pushed_meta["weight"]:
+            self.metadata.weight = np.concatenate(
+                self._pushed_meta["weight"])
+        if self.reference is not None:
+            ref = self.reference.construct()
+            self.binned = np.concatenate(self._pushed, axis=0)
+            self.num_data = len(self.binned)
+            for fname in ("label", "weight"):
+                v = getattr(self.metadata, fname)
+                if v is not None and len(v) != self.num_data:
+                    log.fatal(f"Length of {fname} ({len(v)}) does not "
+                              f"match number of pushed rows "
+                              f"({self.num_data})")
+            self.num_total_features = ref.num_total_features
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.feature_names = ref.feature_names
+            self.categorical_idx = ref.categorical_idx
+            self._pushed = []
+            self._constructed = True
+            return True
+        # no reference: hand the stacked raw rows to the normal path
+        self.data = np.concatenate(self._pushed, axis=0)
+        self._pushed = []
+        return False
+
     def set_label(self, label) -> "Dataset":
         self.metadata.label = _coerce_1d(label).ravel()
         return self
@@ -263,6 +338,11 @@ class Dataset:
                                     _coerce_1d(init_score))
         return self
 
+    def set_position(self, position) -> "Dataset":
+        self.metadata.position = (None if position is None else
+                                  _coerce_1d(position).astype(np.int32))
+        return self
+
     def set_field(self, field_name: str, data) -> "Dataset":
         if field_name == "label":
             return self.set_label(data)
@@ -272,6 +352,8 @@ class Dataset:
             return self.set_group(data)
         if field_name == "init_score":
             return self.set_init_score(data)
+        if field_name == "position":
+            return self.set_position(data)
         log.fatal(f"Unknown field name {field_name}")
 
     def get_field(self, field_name: str):
@@ -283,6 +365,8 @@ class Dataset:
             return self.metadata.query_boundaries
         if field_name == "init_score":
             return self.metadata.init_score
+        if field_name == "position":
+            return self.metadata.position
         log.fatal(f"Unknown field name {field_name}")
 
     def get_label(self):
@@ -373,6 +457,8 @@ class Dataset:
     def __len__(self) -> int:
         if self._constructed:
             return self.num_data
+        if self.data is None:             # push_rows-style streaming
+            return sum(len(c) for c in getattr(self, "_pushed", []))
         if hasattr(self.data, "shape"):   # ndarray/scipy/pandas — no
             return int(self.data.shape[0])  # densifying coercion
         if hasattr(self.data, "num_rows"):  # pyarrow
@@ -400,6 +486,8 @@ class Dataset:
             sub.metadata.weight = md.weight[idx]
         if md.init_score is not None:
             sub.metadata.init_score = np.asarray(md.init_score)[idx]
+        if md.position is not None:
+            sub.metadata.position = md.position[idx]
         if md.query_boundaries is not None:
             # rebuild query boundaries from per-row query ids; assumes idx
             # keeps whole queries together (cv's group-aware folds do)

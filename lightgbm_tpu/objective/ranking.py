@@ -48,13 +48,27 @@ class _RankingBase(Objective):
         self._label_gain_table = None   # filled by prepare()
 
     def setup_queries(self, query_boundaries: np.ndarray,
-                      n_rows: int) -> None:
+                      n_rows: int, position=None) -> None:
         if query_boundaries is None:
             log.fatal("Ranking objective requires query/group information")
         idx, counts, M = _pad_queries(query_boundaries)
         self._qidx = jnp.asarray(idx)
         self._qmask = jnp.asarray(idx >= 0)
         self._n_rows = n_rows
+        # explicit per-row presentation positions (Metadata::positions,
+        # v4.2+): padded to [Q, M]; consumed by lambdarank_unbiased in
+        # place of the score rank
+        self._qpos = None
+        if position is not None:
+            pos = np.asarray(position, dtype=np.int64).ravel()
+            if len(pos) != n_rows:
+                log.fatal(f"Length of position ({len(pos)}) does not "
+                          f"match number of data ({n_rows})")
+            if pos.min() < 0:
+                log.fatal("position field must be non-negative")
+            padded = np.where(idx >= 0, pos[np.clip(idx, 0, None)], 0)
+            self._qpos = jnp.asarray(padded.astype(np.int32))
+            self._n_positions = int(pos.max()) + 1
 
     def _gather_queries(self, arr):
         safe = jnp.maximum(self._qidx, 0)
@@ -84,10 +98,13 @@ class LambdaRank(_RankingBase):
             config, "lambdarank_position_bias_regularization", 0.0))
 
     def init_pos_state(self):
-        """Initial per-rank propensities: all ones ([2, M] — row 0 = t+
-        indexed by the HIGH doc's score rank, row 1 = t-)."""
-        M = self._qidx.shape[1]
-        return jnp.ones((2, M), jnp.float32)
+        """Initial per-rank propensities: all ones ([2, S] — row 0 = t+
+        for the HIGH doc, row 1 = t- for the LOW doc). S = the position
+        space: max explicit position + 1 when the dataset carries a
+        ``position`` field, else the padded query length (score ranks)."""
+        S = (self._n_positions if getattr(self, "_qpos", None) is not None
+             else self._qidx.shape[1])
+        return jnp.ones((2, S), jnp.float32)
 
     def prepare(self, label: np.ndarray, weight) -> None:
         max_label = int(label.max())
@@ -106,17 +123,22 @@ class LambdaRank(_RankingBase):
         sig = self.sigmoid
         gains_tbl = self._label_gain_table
         unbiased = self.unbiased
+        use_pos = unbiased and getattr(self, "_qpos", None) is not None
         if unbiased:
+            S = (self._n_positions if use_pos else M)
             bias_hi = (pos_state[0] if pos_state is not None
-                       else jnp.ones(M, jnp.float32))
+                       else jnp.ones(S, jnp.float32))
             bias_lo = (pos_state[1] if pos_state is not None
-                       else jnp.ones(M, jnp.float32))
+                       else jnp.ones(S, jnp.float32))
 
         s = jnp.where(self._qmask, self._gather_queries(score), -jnp.inf)
         y = jnp.where(self._qmask,
                       self._gather_queries(label).astype(jnp.int32), -1)
 
-        def per_query(sq, yq, maskq):
+        qpos_all = (self._qpos if use_pos
+                    else jnp.zeros_like(self._qidx))
+
+        def per_query(sq, yq, maskq, pq):
             # score-descending order (ties broken by index, like a stable
             # sort on the reference side)
             order = jnp.argsort(-sq, stable=True)          # [M]
@@ -156,9 +178,16 @@ class LambdaRank(_RankingBase):
             lam = jnp.where(pair_ok, lam, 0.0)
             hess_pair = jnp.where(pair_ok, hess_pair, 0.0)
             if unbiased:
-                # score rank of the high/low doc of each pair
-                ri = jnp.arange(T, dtype=jnp.int32)[:, None]
-                rj = jnp.arange(M, dtype=jnp.int32)[None, :]
+                # position of the high/low doc of each pair: the
+                # dataset's explicit presentation position when given,
+                # else the score rank
+                if use_pos:
+                    p_sorted = pq[order]
+                    ri = p_sorted[:T, None]
+                    rj = p_sorted[None, :]
+                else:
+                    ri = jnp.arange(T, dtype=jnp.int32)[:, None]
+                    rj = jnp.arange(M, dtype=jnp.int32)[None, :]
                 rank_h = jnp.where(i_is_high, ri, rj)       # [T, M]
                 rank_l = jnp.where(i_is_high, rj, ri)
                 t_hi = bias_hi[rank_h]
@@ -169,15 +198,15 @@ class LambdaRank(_RankingBase):
                 p_cost = jnp.where(
                     pair_ok,
                     -jnp.log(jnp.maximum(1.0 - rho, 1e-20)) * delta, 0.0)
-                cost_hi_q = jnp.zeros(M, jnp.float32).at[rank_h].add(
+                cost_hi_q = jnp.zeros(S, jnp.float32).at[rank_h].add(
                     p_cost / t_lo)
-                cost_lo_q = jnp.zeros(M, jnp.float32).at[rank_l].add(
+                cost_lo_q = jnp.zeros(S, jnp.float32).at[rank_l].add(
                     p_cost / t_hi)
                 inv_w = 1.0 / (t_hi * t_lo)
                 lam = lam * inv_w
                 hess_pair = hess_pair * inv_w
             else:
-                cost_hi_q = cost_lo_q = jnp.zeros(M, jnp.float32)
+                cost_hi_q = cost_lo_q = jnp.zeros(1, jnp.float32)
 
             # accumulate: high doc gets -lam, low doc gets +lam
             lam_i = jnp.where(i_is_high, -lam, lam)         # [T, M]
@@ -202,7 +231,7 @@ class LambdaRank(_RankingBase):
             return grad_q, hess_q, cost_hi_q, cost_lo_q
 
         grad_q, hess_q, cost_hi, cost_lo = jax.vmap(per_query)(
-            s, y, self._qmask)
+            s, y, self._qmask, qpos_all)
 
         grad = jnp.zeros(score.shape[0], jnp.float32)
         hess = jnp.zeros(score.shape[0], jnp.float32)
@@ -224,7 +253,11 @@ class LambdaRank(_RankingBase):
         clo = jnp.sum(cost_lo, axis=0)
 
         def propensity(c):
-            c0 = jnp.maximum(c[0], 1e-20)
+            # anchor on the first position that actually accumulated
+            # cost (1-based or sparse position ids leave c[0] == 0,
+            # which would blow the ratio up by ~1e20)
+            first = jnp.argmax(c > 0)
+            c0 = jnp.maximum(c[first], 1e-20)
             ratio = jnp.maximum(c / c0, 1e-6)
             t = ratio ** self.bias_p_norm
             t = (t + self.bias_reg) / (1.0 + self.bias_reg)

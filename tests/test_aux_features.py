@@ -196,3 +196,36 @@ def test_tpu_profile_dir_writes_trace(tmp_path):
     for root, _dirs, files in os.walk(d):
         found.extend(files)
     assert found, "profiler trace produced no files"
+
+
+def test_push_rows_streaming():
+    """Streamed chunk ingestion (LGBM_DatasetPushRows analog): pushing
+    row chunks against a reference bins immediately and must equal the
+    one-shot dataset; valid-set evaluation on the streamed set matches."""
+    X, y = _binary_data(3000, 6, seed=9)
+    train = lgb.Dataset(X[:2000], label=y[:2000])
+    train.construct()
+    streamed = lgb.Dataset(None, reference=train)
+    for lo in range(2000, 3000, 256):
+        hi = min(lo + 256, 3000)
+        streamed.push_rows(X[lo:hi], label=y[lo:hi])
+    streamed.construct()
+    oneshot = train.create_valid(X[2000:], label=y[2000:])
+    oneshot.construct()
+    np.testing.assert_array_equal(streamed.binned, oneshot.binned)
+    np.testing.assert_array_equal(streamed.get_label(), y[2000:])
+    # trains + evals through the engine
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "metric": "auc", "verbosity": -1}, train,
+                    num_boost_round=5, valid_sets=[streamed])
+    assert np.isfinite(bst.predict(X[2000:])).all()
+
+
+def test_push_rows_without_reference():
+    X, y = _binary_data(1200, 5, seed=11)
+    ds = lgb.Dataset(None)
+    ds.push_rows(X[:600], label=y[:600])
+    ds.push_rows(X[600:], label=y[600:])
+    ref = lgb.Dataset(X, label=y)
+    ds.construct(); ref.construct()
+    np.testing.assert_array_equal(ds.binned, ref.binned)

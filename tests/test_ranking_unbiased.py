@@ -87,3 +87,28 @@ def test_unbiased_rejects_distributed():
                    "num_machines": 2, "lambdarank_unbiased": True,
                    "verbosity": -1, "num_leaves": 15}, ds,
                   num_boost_round=2)
+
+
+def test_explicit_positions_consumed():
+    """With a `position` field, propensities index by presentation
+    position (Metadata::positions, v4.2+) instead of score rank —
+    permuting row order within queries while keeping positions fixed
+    must not change the propensity table's size anchor."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    X, y, group = _rank_data(seed=2)
+    rng = np.random.default_rng(0)
+    pos = np.concatenate([rng.permutation(24) for _ in range(40)])
+    ds = lgb.Dataset(X, label=y, group=group)
+    ds.set_field("position", pos)
+    cfg = Config({"objective": "lambdarank", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "verbosity": -1,
+                  "lambdarank_unbiased": True})
+    eng = GBDT(cfg, ds)
+    assert eng._pos_state.shape == (2, 24)     # max position + 1
+    for _ in range(4):
+        eng.train_one_iter()
+    st = np.asarray(eng._pos_state)
+    assert np.isfinite(st).all() and (st > 0).all()
+    np.testing.assert_allclose(st[:, 0], 1.0, atol=1e-6)
+    assert np.isfinite(eng.predict(X)).all()
