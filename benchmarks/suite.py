@@ -1,0 +1,143 @@
+"""Secondary benchmark suite: the non-flagship BASELINE.json configs.
+
+Each config is a synthetic stand-in with the SHAPE of the named public
+dataset (no network in this environment — see BASELINE.md): the point is
+iters/sec + a sanity quality metric per capability combination, not
+dataset-accurate AUC. The flagship (Higgs-1M plain hist) lives in
+bench.py; the driver records only that one line. Results are pasted into
+docs/perf.md.
+
+Run: python benchmarks/suite.py [config ...]   (default: all)
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _time_chunks(eng, warm, timed):
+    import jax
+    eng.train_chunk(warm)
+    jax.block_until_ready(eng.score)
+    t0 = time.time()
+    eng.train_chunk(timed)
+    jax.block_until_ready(eng.score)
+    return timed / (time.time() - t0)
+
+
+def bench_mslr():
+    """MSLR-Web30K shape: LambdaRank, 136 dense features, ~120-doc
+    queries. 500k rows."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    rng = np.random.default_rng(0)
+    n_q, per_q, F = 4096, 122, 136
+    n = n_q * per_q
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    w = rng.normal(size=F) * (rng.random(F) < 0.3)
+    rel = np.clip((X @ w) * 0.35 + rng.normal(scale=0.8, size=n) + 1.2,
+                  0, 4).astype(int).astype(float)
+    ds = lgb.Dataset(X.astype(np.float64), label=rel,
+                     group=np.full(n_q, per_q))
+    cfg = Config({"objective": "lambdarank", "num_leaves": 127,
+                  "max_bin": 255, "learning_rate": 0.1, "verbosity": -1})
+    eng = GBDT(cfg, ds)
+    ips = _time_chunks(eng, 10, 20)
+    from lightgbm_tpu.metric import NDCGMetric
+    ndcg = NDCGMetric(cfg).eval(eng.predict(X), rel, None,
+                                ds.metadata.query_boundaries)[0][1]
+    return {"config": "mslr-synth lambdarank (500k x 136, q=122)",
+            "iters_per_sec": round(ips, 3),
+            "quality": {"train_ndcg": round(float(ndcg), 4)}}
+
+
+def bench_bosch():
+    """Bosch/M5 shape: GOSS + DART + monotone constraints, 300k x 200
+    regression."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.engine import train
+    rng = np.random.default_rng(1)
+    n, F = 300_000, 200
+    X = rng.normal(size=(n, F))
+    y = (X[:, 0] * 2.0 + np.abs(X[:, 1]) + 0.3 * X[:, 2] ** 2
+         + rng.normal(scale=0.5, size=n))
+    mono = [0] * F
+    mono[0] = 1
+    ds = lgb.Dataset(X, label=y)
+    t0 = time.time()
+    n_rounds = 30
+    bst = train({"objective": "regression", "boosting": "dart",
+                 "data_sample_strategy": "goss", "num_leaves": 127,
+                 "max_bin": 255, "monotone_constraints": mono,
+                 "learning_rate": 0.1, "verbosity": -1}, ds,
+                num_boost_round=n_rounds)
+    dt = time.time() - t0
+    pred = bst.predict(X)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    return {"config": "bosch-synth goss+dart+monotone (300k x 200)",
+            "iters_per_sec": round(n_rounds / dt, 3),
+            "note": "incl. compile (DART re-traces on drop-set changes)",
+            "quality": {"train_rmse": round(rmse, 4),
+                        "label_std": round(float(y.std()), 4)}}
+
+
+def bench_criteo():
+    """Criteo shape: 13 dense + 26 categorical + 160 sparse binaries
+    with EFB, binary CTR, 1M rows."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    rng = np.random.default_rng(2)
+    n = 1_000_000
+    dense = rng.lognormal(size=(n, 13)).astype(np.float32)
+    cats = np.stack([rng.integers(0, c, size=n) for c in
+                     ([8, 16, 32, 64, 128, 256] * 5)[:26]], axis=1)
+    # 20 groups of 8 mutually-exclusive indicators (one-hot-expanded
+    # categoricals, the Criteo-CTR shape EFB exists for) — plus rows
+    # where the whole group is absent, so columns stay sparse
+    groups = []
+    for gi in range(20):
+        sel = rng.integers(0, 9, size=n)          # 8 = absent
+        oh = (sel[:, None] == np.arange(8)[None, :]).astype(np.float32)
+        groups.append(oh)                         # 0/1 indicators: 2-3
+    sparse = np.concatenate(groups, axis=1)       # bins each -> EFB
+    X = np.concatenate([dense, cats.astype(np.float32), sparse], axis=1)
+    logit = (0.4 * np.log1p(dense[:, 0]) + 0.3 * (cats[:, 0] % 3 == 0)
+             + sparse[:, 0] - 0.8)
+    y = (logit + rng.normal(scale=1.0, size=n) > 0).astype(np.float64)
+    t_bin = time.time()
+    ds = lgb.Dataset(X.astype(np.float64), label=y,
+                     categorical_feature=list(range(13, 39)),
+                     params={"enable_bundle": True})
+    cfg = Config({"objective": "binary", "num_leaves": 127,
+                  "max_bin": 255, "enable_bundle": True,
+                  "learning_rate": 0.1, "verbosity": -1})
+    eng = GBDT(cfg, ds)
+    bin_s = time.time() - t_bin
+    ips = _time_chunks(eng, 10, 20)
+    from lightgbm_tpu.metric import AUCMetric
+    auc = AUCMetric(cfg).eval(eng.predict(X[:100_000]), y[:100_000],
+                              None)[0][1]
+    nb = eng.data.bins.shape[1]     # physical (bundled) column count
+    return {"config": "criteo-synth efb+categorical (1M x 199)",
+            "iters_per_sec": round(ips, 3),
+            "quality": {"train_auc_100k": round(float(auc), 4)},
+            "efb": {"physical_columns": int(nb), "logical_features": 199,
+                    "binning_s": round(bin_s, 1)}}
+
+
+ALL = {"mslr": bench_mslr, "bosch": bench_bosch, "criteo": bench_criteo}
+
+if __name__ == "__main__":
+    picks = sys.argv[1:] or list(ALL)
+    for name in picks:
+        try:
+            print(json.dumps(ALL[name]()), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": name,
+                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)

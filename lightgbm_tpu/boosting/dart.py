@@ -85,7 +85,13 @@ class DART(GBDT):
         if k:
             model_idx = [int(i) * K + c
                          for i in drop_iters for c in range(K)]
-            stacked, class_idx = self._stack_model_list(model_idx)
+            # pad to a power-of-two tree count and the static leaf width
+            # so forest_predict_binned compiles once per bucket, not once
+            # per distinct drop set
+            pad_count = 1 << (len(model_idx) - 1).bit_length()
+            stacked, class_idx = self._stack_model_list(
+                model_idx, pad_count=pad_count,
+                pad_leaves=self.config.num_leaves)
             drop_contrib, _ = forest_predict_binned(
                 stacked, self.data.bins, self.feat_num_bin,
                 self.feat_has_nan, class_idx, K)

@@ -255,6 +255,11 @@ class GBDT:
         self.feat_has_nan = jnp.asarray(has_nan)
         self.has_categorical = bool(is_cat.any())
         self.feat_is_cat = jnp.asarray(is_cat)
+        # static categorical positions for the sliced split-search fast
+        # path (ops/split.py cat_positions); scatter/feature-parallel
+        # shards search dynamic slices, so they fall back to the masked
+        # full-width scan
+        self._cat_positions = tuple(int(i) for i in np.nonzero(is_cat)[0])
 
         # monotone constraints ([F_pad] int8 by used-feature index;
         # categorical features are never direction-constrained)
@@ -469,6 +474,9 @@ class GBDT:
 
     def _make_grow_cfg(self) -> GrowConfig:
         config = self.config
+        _hist_scatter = (self.learner_type == "data"
+                         and config.tpu_hist_reduce == "scatter"
+                         and not self.has_bundles)
         return GrowConfig(
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
@@ -486,14 +494,15 @@ class GBDT:
             axis_name=(self.axis if self.mesh is not None
                        and not self._shard_features else ""),
             has_categorical=self.has_categorical,
+            cat_positions=(self._cat_positions
+                           if not (self._shard_features or _hist_scatter)
+                           else ()),
             max_cat_threshold=config.max_cat_threshold,
             cat_smooth=config.cat_smooth,
             cat_l2=config.cat_l2,
             max_cat_to_onehot=config.max_cat_to_onehot,
             min_data_per_group=config.min_data_per_group,
-            hist_scatter=(self.learner_type == "data"
-                          and config.tpu_hist_reduce == "scatter"
-                          and not self.has_bundles),
+            hist_scatter=_hist_scatter,
             num_shards=(self.mesh.devices.size
                         if self.mesh is not None else 1),
             voting=self.learner_type == "voting",
@@ -660,10 +669,25 @@ class GBDT:
             sorted_m = jnp.sort(metric)
             thresh_idx = jnp.clip(n_local - k_top, 0, n_local - 1)
             thresh = sorted_m[thresh_idx]
-            is_top = (metric >= thresh) & (valid_mask > 0) & (k_top > 0)
-            rest = (valid_mask > 0) & ~is_top
+            # EXACT top-k (goss.hpp partitions exactly k rows): ties at
+            # the threshold break by row index via a cumulative count,
+            # so the selected count is deterministic — required both for
+            # reference parity and so the compact path's fixed buffer
+            # (tpu_goss_compact) can never truncate
+            valid = valid_mask > 0
+            above = (metric > thresh) & valid
+            k_need = k_top - jnp.sum(above).astype(jnp.int32)
+            tie = (metric == thresh) & valid
+            tie_rank = jnp.cumsum(tie.astype(jnp.int32))
+            is_top = (above | (tie & (tie_rank <= k_need))) & (k_top > 0)
+            rest = valid & ~is_top
             p_pick = jnp.minimum(k_rand / k_rest, 1.0)
             picked = rest & (jax.random.uniform(key, (n_local,)) < p_pick)
+            # cap the random side at exactly ceil(k_rand) rows (the
+            # reference samples a fixed-size subset, not a binomial)
+            k_cap = jnp.ceil(k_rand).astype(jnp.int32)
+            picked = picked & (jnp.cumsum(picked.astype(jnp.int32))
+                               <= k_cap)
             amp = (1.0 - top_rate) / max(other_rate, 1e-12)
             mask_gh = (is_top.astype(jnp.float32)
                        + picked.astype(jnp.float32) * amp)
@@ -684,6 +708,109 @@ class GBDT:
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
                             allowed, qkey=key, cegb_pen=cegb_pen)
 
+        # ---- GOSS physical row compaction (tpu_goss_compact) -----------
+        # The masked formulation scans ALL rows with zero weights; the
+        # reference's GOSS scans only the sampled subset
+        # (goss.hpp bag_data_indices_). Here: fixed-size gather of the
+        # sampled rows (static n_sub >= worst-case sample), tree growth
+        # on the compacted arrays, and full-data score updates by tree
+        # traversal (the same path valid-set eval uses). Sample choice is
+        # bit-identical to the masked path (same RNG stream); histogram
+        # float sums may differ only in accumulation order.
+        renews_obj = (type(obj).renew_tree_output
+                      is not Objective.renew_tree_output)
+        use_goss_compact = (bool(self.config.tpu_goss_compact)
+                           and self.config.data_sample_strategy == "goss"
+                           and mesh is None and not self.has_bundles
+                           and not self.linear_tree and not renews_obj
+                           and not (use_quant and renew_quant)
+                           and not getattr(obj, "has_pos_state", False)
+                           and top_rate + other_rate < 1.0)
+        self._use_goss_compact = use_goss_compact
+        if use_goss_compact:
+            from ..ops.histogram import pad_rows as _pad_rows
+            dd = self.data
+            n_full = dd.n_pad
+            frac = top_rate + other_rate
+            n_sub = min(_pad_rows(int(np.ceil(n_full * frac)) + 8192,
+                                  gcfg.rows_per_block), n_full)
+
+            def step_goss_compact_impl(bins, bins_t, label, weight,
+                                       valid_mask, score, allowed,
+                                       cegb_pen, key):
+                kg, km = jax.random.split(key)
+                g, h = gradients(score, label, weight, kg)
+                mask_gh, mask_count = goss_masks(g, h, valid_mask, km)
+                sel = mask_count > 0
+                # TPU note: jnp.nonzero / gathers at computed indices
+                # lower to serialized scatter/slice loops (~1s at 1M
+                # rows). ONE multi-operand lax.sort moves the sampled
+                # rows to the front instead (~13 ms at F=28): the key
+                # orders selected rows (by index) before unselected, and
+                # bins + grad/hess/masks ride along as payload.
+                iota = jnp.arange(n_full, dtype=jnp.int32)
+                skey = jnp.where(sel, iota, iota + n_full)
+                g2 = g if K > 1 else g[:, None]
+                h2 = h if K > 1 else h[:, None]
+                ops = ([skey] + [bins[:, f]
+                                 for f in range(bins.shape[1])]
+                       + [g2[:, k] for k in range(K)]
+                       + [h2[:, k] for k in range(K)]
+                       + [mask_gh, mask_count])
+                sorted_ops = jax.lax.sort(ops, num_keys=1,
+                                          is_stable=False)
+                cut = [o[:n_sub] for o in sorted_ops]
+                lane = cut[0] < n_full
+                Fb = bins.shape[1]
+                bins_c = jnp.stack(cut[1:1 + Fb], axis=1)
+                g_c = jnp.stack(cut[1 + Fb:1 + Fb + K], axis=1)
+                h_c = jnp.stack(cut[1 + Fb + K:1 + Fb + 2 * K], axis=1)
+                mgh_c = jnp.where(lane, cut[-2], 0.0)
+                mc_c = jnp.where(lane, cut[-1], 0.0)
+                bins_t_c = (bins_c.astype(jnp.int8).T
+                            if bins_t is not None else None)
+                qkey = jax.random.fold_in(key, 0x9e37)
+                trees, leaf_ids = [], []
+                new_score = score
+                for k in range(K):
+                    gk = g_c[:, k] * mgh_c
+                    hk = h_c[:, k] * mgh_c
+                    chan_scale = None
+                    if use_quant:
+                        kq = jax.random.fold_in(qkey, k)
+                        gk, hk, chan_scale = quantize(gk, hk, mc_c, kq)
+                    vals = jnp.stack([gk, hk, mc_c], axis=1)
+                    tree, leaf_id_c = grow_tree(
+                        bins_c, vals, self.feat_num_bin,
+                        self.feat_has_nan, allowed, gcfg,
+                        bins_t=bins_t_c, is_cat=self.feat_is_cat,
+                        mono=self.feat_mono,
+                        groups=self.interaction_groups,
+                        chan_scale=chan_scale,
+                        node_key=jax.random.fold_in(qkey, 0xB14D + k),
+                        cegb_pen=cegb_pen)
+                    # full-data score update by traversal — unsampled
+                    # rows need this iteration's tree too
+                    vals_full, _ = tree_predict_binned(
+                        tree, bins, self.feat_num_bin,
+                        self.feat_has_nan)
+                    new_score = new_score.at[:, k].add(vals_full * lr)
+                    trees.append(tree)
+                    leaf_ids.append(leaf_id_c)
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+                return stacked, jnp.stack(leaf_ids), new_score
+
+            _compact_j = jax.jit(step_goss_compact_impl)
+
+            def _step_goss_compact(score, allowed, cegb_pen, key):
+                return _compact_j(dd.bins, dd.bins_t, dd.label,
+                                  dd.weight, dd.valid_mask, score,
+                                  allowed, cegb_pen, key)
+
+            self._step_goss_compact = _step_goss_compact
+        else:
+            self._step_goss_compact = None
+
         def valid_update_impl(valid_bins_scores, stacked_trees):
             # apply this iteration's K trees to each valid set's raw scores
             out = []
@@ -698,66 +825,94 @@ class GBDT:
                 out.append(new)
             return out
 
-        @jax.jit
+        # NOTE on jit boundaries: device arrays CLOSED OVER by a jitted
+        # function are embedded into the lowered HLO as constants, so the
+        # (remote) compile payload grows with the dataset. Every step jit
+        # below therefore takes the big arrays as ARGUMENTS; thin Python
+        # wrappers supply them per call (no transfer cost — they are
+        # device-resident).
+        _valid_update_j = jax.jit(
+            lambda vbins, valid_scores, stacked_trees: valid_update_impl(
+                list(zip(vbins, valid_scores)), stacked_trees))
+
         def plain_valid_update(valid_scores, stacked_trees):
-            pairs = [(self.valid_data[i].bins, s)
-                     for i, s in enumerate(valid_scores)]
-            return valid_update_impl(pairs, stacked_trees)
+            vbins = tuple(self.valid_data[i].bins
+                          for i in range(len(valid_scores)))
+            return _valid_update_j(vbins, tuple(valid_scores),
+                                   stacked_trees)
 
         if mesh is None:
             d = self.data
+            _step_j = jax.jit(step_impl)
+            _goss_j = jax.jit(step_goss_impl)
+            _custom_j = jax.jit(step_custom_impl)
 
-            @jax.jit
             def step(score, mask_gh, mask_count, allowed, cegb_pen, key):
-                return step_impl(d.bins, d.bins_t, d.label, d.weight, score,
-                                 mask_gh, mask_count, allowed, cegb_pen,
-                                 key)
+                return _step_j(d.bins, d.bins_t, d.label, d.weight, score,
+                               mask_gh, mask_count, allowed, cegb_pen,
+                               key)
 
-            @jax.jit
             def step_goss(score, allowed, cegb_pen, key):
-                return step_goss_impl(d.bins, d.bins_t, d.label, d.weight,
-                                      score, d.valid_mask, allowed,
-                                      cegb_pen, key)
+                return _goss_j(d.bins, d.bins_t, d.label, d.weight,
+                               score, d.valid_mask, allowed, cegb_pen,
+                               key)
 
-            @jax.jit
             def step_custom(score, g, h, mask_gh, mask_count, allowed,
                             cegb_pen, key):
-                return step_custom_impl(d.bins, d.bins_t, score, g, h,
-                                        mask_gh, mask_count, allowed,
-                                        cegb_pen, key)
+                return _custom_j(d.bins, d.bins_t, score, g, h,
+                                 mask_gh, mask_count, allowed, cegb_pen,
+                                 key)
 
             if getattr(obj, "has_pos_state", False):
                 # stateful objective: gradients also return updated
                 # position-bias state, threaded by train_one_iter
-                def grads_state(score, pos_state):
+                def grads_state(score, label, weight, pos_state):
                     s = score[:, 0] if K == 1 else score
-                    return obj.get_gradients(s, d.label, d.weight,
+                    return obj.get_gradients(s, label, weight,
                                              pos_state=pos_state)
 
-                @jax.jit
-                def step_state(score, mask_gh, mask_count, allowed,
-                               cegb_pen, key, pos_state):
-                    g, h, new_state = grads_state(score, pos_state)
+                def _state_impl(bins, bins_t, label, weight, score,
+                                mask_gh, mask_count, allowed, cegb_pen,
+                                key, pos_state):
+                    g, h, new_state = grads_state(score, label, weight,
+                                                  pos_state)
                     stacked, lids, ns = grow_all(
-                        d.bins, d.bins_t, score, g, h, mask_gh,
+                        bins, bins_t, score, g, h, mask_gh,
                         mask_count, allowed,
                         qkey=jax.random.fold_in(key, 0x9e37),
                         cegb_pen=cegb_pen)
                     return stacked, lids, ns, new_state
 
-                @jax.jit
-                def step_goss_state(score, allowed, cegb_pen, key,
-                                    pos_state):
+                def _goss_state_impl(bins, bins_t, label, weight, score,
+                                     valid_mask, allowed, cegb_pen, key,
+                                     pos_state):
                     kg, km = jax.random.split(key)
-                    g, h, new_state = grads_state(score, pos_state)
-                    mask_gh, mask_count = goss_masks(g, h, d.valid_mask,
+                    g, h, new_state = grads_state(score, label, weight,
+                                                  pos_state)
+                    mask_gh, mask_count = goss_masks(g, h, valid_mask,
                                                      km)
                     stacked, lids, ns = grow_all(
-                        d.bins, d.bins_t, score, g, h, mask_gh,
+                        bins, bins_t, score, g, h, mask_gh,
                         mask_count, allowed,
                         qkey=jax.random.fold_in(key, 0x9e37),
                         cegb_pen=cegb_pen)
                     return stacked, lids, ns, new_state
+
+                _state_j = jax.jit(_state_impl)
+                _goss_state_j = jax.jit(_goss_state_impl)
+
+                def step_state(score, mask_gh, mask_count, allowed,
+                               cegb_pen, key, pos_state):
+                    return _state_j(d.bins, d.bins_t, d.label, d.weight,
+                                    score, mask_gh, mask_count, allowed,
+                                    cegb_pen, key, pos_state)
+
+                def step_goss_state(score, allowed, cegb_pen, key,
+                                    pos_state):
+                    return _goss_state_j(d.bins, d.bins_t, d.label,
+                                         d.weight, score, d.valid_mask,
+                                         allowed, cegb_pen, key,
+                                         pos_state)
 
                 self._step_state = step_state
                 self._step_goss_state = step_goss_state
@@ -821,24 +976,25 @@ class GBDT:
                           row1, row1, rep, rep, rep),
                 out_specs=out_specs, check_vma=False)
 
-            @jax.jit
+            _sh_step_j = jax.jit(sharded_step)
+            _sh_goss_j = jax.jit(sharded_goss)
+            _sh_custom_j = jax.jit(sharded_custom)
+
             def step(score, mask_gh, mask_count, allowed, cegb_pen, key):
-                return sharded_step(d.bins, d.bins_t, d.label, d.weight,
-                                    score, mask_gh, mask_count, allowed,
-                                    cegb_pen, key)
+                return _sh_step_j(d.bins, d.bins_t, d.label, d.weight,
+                                  score, mask_gh, mask_count, allowed,
+                                  cegb_pen, key)
 
-            @jax.jit
             def step_goss(score, allowed, cegb_pen, key):
-                return sharded_goss(d.bins, d.bins_t, d.label, d.weight,
-                                    score, d.valid_mask, allowed,
-                                    cegb_pen, key)
+                return _sh_goss_j(d.bins, d.bins_t, d.label, d.weight,
+                                  score, d.valid_mask, allowed,
+                                  cegb_pen, key)
 
-            @jax.jit
             def step_custom(score, g, h, mask_gh, mask_count, allowed,
                             cegb_pen, key):
-                return sharded_custom(d.bins, d.bins_t, score, g, h,
-                                      mask_gh, mask_count, allowed,
-                                      cegb_pen, key)
+                return _sh_custom_j(d.bins, d.bins_t, score, g, h,
+                                    mask_gh, mask_count, allowed,
+                                    cegb_pen, key)
 
             if self._shard_features:
                 # feature-parallel valid sets are replicated (prediction
@@ -883,7 +1039,11 @@ class GBDT:
             def chunk_impl(bins, bins_t, label, weight, score, valid_mask,
                            keys):
                 def body(sc, bkey):
-                    if goss:
+                    if goss and use_goss_compact:
+                        stacked, _lid, ns = step_goss_compact_impl(
+                            bins, bins_t, label, weight, valid_mask,
+                            sc, allowed_all, None, bkey)
+                    elif goss:
                         stacked, _lid, ns = step_goss_impl(
                             bins, bins_t, label, weight, sc, valid_mask,
                             allowed_all, None, bkey)
@@ -895,11 +1055,12 @@ class GBDT:
                 return jax.lax.scan(body, score, keys)
 
             if mesh is None:
-                @jax.jit
+                _chunk_j = jax.jit(chunk_impl)
+
                 def chunk(score, keys):
-                    return chunk_impl(d_.bins, d_.bins_t, d_.label,
-                                      d_.weight, score, d_.valid_mask,
-                                      keys)
+                    return _chunk_j(d_.bins, d_.bins_t, d_.label,
+                                    d_.weight, score, d_.valid_mask,
+                                    keys)
                 return chunk
 
             sharded_chunk = shard_map(
@@ -908,10 +1069,11 @@ class GBDT:
                           rep),
                 out_specs=(row2, tree_specs), check_vma=False)
 
-            @jax.jit
+            _sh_chunk_j = jax.jit(sharded_chunk)
+
             def chunk(score, keys):
-                return sharded_chunk(d_.bins, d_.bins_t, d_.label,
-                                     d_.weight, score, d_.valid_mask, keys)
+                return _sh_chunk_j(d_.bins, d_.bins_t, d_.label,
+                                   d_.weight, score, d_.valid_mask, keys)
             return chunk
 
         self._make_chunk = make_chunk
@@ -1003,6 +1165,9 @@ class GBDT:
                     self._step_goss_state(self.score, allowed,
                                           self._cegb_pen(), key,
                                           self._pos_state)
+            elif self._step_goss_compact is not None:
+                stacked, leaf_ids, new_score = self._step_goss_compact(
+                    self.score, allowed, self._cegb_pen(), key)
             else:
                 stacked, leaf_ids, new_score = self._step_goss(
                     self.score, allowed, self._cegb_pen(), key)
@@ -1270,23 +1435,32 @@ class GBDT:
         """Stack host trees [start, start+num) into device arrays."""
         return self._stack_model_list(list(range(start, start + num)))
 
-    def _stack_model_list(self, indices: List[int]):
+    def _stack_model_list(self, indices: List[int], pad_count: int = 0,
+                          pad_leaves: int = 0):
         """Stack an arbitrary subset of host trees into device arrays
-        (DART needs non-contiguous dropped-tree subsets)."""
+        (DART needs non-contiguous dropped-tree subsets).
+
+        ``pad_count``/``pad_leaves`` stabilize the stacked SHAPES so the
+        consumer jit does not recompile per distinct subset: the stack is
+        padded to ``pad_count`` single-leaf zero-value trees (inert under
+        traversal) and every per-tree array to ``pad_leaves`` slots."""
         trees = [self.models[i] for i in indices]
-        L = max((t.num_leaves for t in trees), default=1)
+        n_real = len(trees)
+        n_pad = max(pad_count, n_real)
+        L = max(max((t.num_leaves for t in trees), default=1), pad_leaves)
         Ln = max(L - 1, 1)
 
         def padded(getter, size, dtype, fill=0):
-            out = np.full((len(trees), size), fill, dtype=dtype)
+            out = np.full((n_pad, size), fill, dtype=dtype)
             for i, t in enumerate(trees):
                 a = getter(t)
                 out[i, :len(a)] = a
             return jnp.asarray(out)
 
         stacked = {
-            "num_leaves": jnp.asarray(
-                np.array([t.num_leaves for t in trees], np.int32)),
+            "num_leaves": jnp.asarray(np.array(
+                [t.num_leaves for t in trees] + [1] * (n_pad - n_real),
+                np.int32)),
             "split_feature": padded(lambda t: t.split_feature, Ln, np.int32),
             "threshold_bin": padded(lambda t: t.threshold_bin, Ln, np.int32),
             "default_left": padded(lambda t: t.default_left, Ln, bool),
@@ -1298,7 +1472,7 @@ class GBDT:
         if any(t.cat_bitset_bins is not None for t in trees):
             W = max(t.cat_bitset_bins.shape[1] for t in trees
                     if t.cat_bitset_bins is not None)
-            bs = np.zeros((len(trees), Ln, W), dtype=np.uint32)
+            bs = np.zeros((n_pad, Ln, W), dtype=np.uint32)
             for i, t in enumerate(trees):
                 if t.cat_bitset_bins is not None:
                     a = t.cat_bitset_bins
@@ -1307,8 +1481,9 @@ class GBDT:
                 lambda t: (t.is_categorical if t.is_categorical is not None
                            else np.zeros(t.num_nodes, bool)), Ln, bool)
             stacked["cat_bitset"] = jnp.asarray(bs)
-        class_idx = jnp.asarray(
-            np.asarray(indices, dtype=np.int32) % self.num_class)
+        class_idx = jnp.asarray(np.asarray(
+            list(indices) + [0] * (n_pad - n_real),
+            dtype=np.int32) % self.num_class)
         return stacked, class_idx
 
     # ------------------------------------------------------------------

@@ -98,8 +98,12 @@ class GrowConfig:
     has_cegb: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
-    # categorical split search (zero-cost when has_categorical=False)
+    # categorical split search (zero-cost when has_categorical=False);
+    # cat_positions: static categorical indices for the sliced fast
+    # path (empty under scatter/feature-parallel whose search space is
+    # a dynamic shard)
     has_categorical: bool = False
+    cat_positions: Tuple = ()
     max_cat_threshold: int = 32
     cat_smooth: float = 10.0
     cat_l2: float = 10.0
@@ -120,6 +124,7 @@ class GrowConfig:
             min_gain_to_split=self.min_gain_to_split,
             max_delta_step=self.max_delta_step,
             has_categorical=self.has_categorical,
+            cat_positions=self.cat_positions,
             max_cat_threshold=self.max_cat_threshold,
             cat_smooth=self.cat_smooth, cat_l2=self.cat_l2,
             max_cat_to_onehot=self.max_cat_to_onehot,
@@ -261,10 +266,14 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         vals_t = vals.T
         # block size must divide the padded row count; rows_per_block does
         # (padding guarantees it), so cap via gcd to keep the streamed
-        # one-hot within scoped VMEM without breaking divisibility
-        # (R=4096 measured fastest on v5e; 8192 regresses, 16384 OOMs)
+        # one-hot within scoped VMEM without breaking divisibility.
+        # R=4096 measured fastest on v5e at Higgs width, but the
+        # feature-blocked grid (F*B > 8192, e.g. MSLR/Criteo widths)
+        # overflows the 16MB scoped-vmem budget at 4096 — those shapes
+        # cap at 2048.
         import math
-        pr = math.gcd(cfg.rows_per_block, 4096)
+        r_cap = 4096 if bins_t.shape[0] * B <= 8192 else 2048
+        pr = math.gcd(cfg.rows_per_block, r_cap)
 
         def hist_multi(leaf_id, small_ids):
             return hist_reduce(multi_leaf_histogram(
@@ -384,10 +393,11 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             ic_e = is_cat[elected] if is_cat is not None else None
             mn_e = mono[elected] if mono is not None else None
             cp_e = cegb_pen[elected] if cegb_pen is not None else None
+            scfg_e = dataclasses.replace(scfg, cat_positions=())
             best = jax.vmap(
                 lambda h, s, nb, hn, al, ic, mn, cp, lo, hi:
                 find_best_split(
-                    h, s, nb, hn, al, scfg, is_cat=ic, mono=mn,
+                    h, s, nb, hn, al, scfg_e, is_cat=ic, mono=mn,
                     out_lower=lo, out_upper=hi, cegb_pen=cp))(
                 hist_e, sums, nb_e, hn_e, al_e, ic_e, mn_e, cp_e,
                 lowers, uppers)

@@ -96,8 +96,14 @@ def multi_leaf_histogram(bins_t: jax.Array, vals_t: jax.Array,
 
     # feature blocking keeps the [B*F_blk, K*C] VMEM accumulator (and the
     # transient one-hot) bounded for wide datasets (MSLR F=136+); at
-    # F*B <= 8192 this is a single block, identical to the unblocked form
-    F_blk = min(F, max(1, 8192 // num_bins))
+    # F*B <= 8192 this is a single block, identical to the unblocked
+    # form. Blocked (wide-F) layouts use a half-size block: [8192, R]
+    # streaming exceeds the 16MB scoped-vmem budget at K*C ~ 96+
+    # (measured: 16.25M at F_blk=32, B=256, R=2048 on v5e).
+    if F * num_bins <= 8192:
+        F_blk = F
+    else:
+        F_blk = max(1, 4096 // num_bins)
     n_fb = (F + F_blk - 1) // F_blk
     F_pad = n_fb * F_blk
     if F_pad > F:
