@@ -681,13 +681,23 @@ class GBDT:
             tie_rank = jnp.cumsum(tie.astype(jnp.int32))
             is_top = (above | (tie & (tie_rank <= k_need))) & (k_top > 0)
             rest = valid & ~is_top
-            p_pick = jnp.minimum(k_rand / k_rest, 1.0)
-            picked = rest & (jax.random.uniform(key, (n_local,)) < p_pick)
-            # cap the random side at exactly ceil(k_rand) rows (the
-            # reference samples a fixed-size subset, not a binomial)
-            k_cap = jnp.ceil(k_rand).astype(jnp.int32)
-            picked = picked & (jnp.cumsum(picked.astype(jnp.int32))
-                               <= k_cap)
+            # EXACT-size uniform sample of the rest (goss.hpp samples a
+            # fixed-size subset): keep the k_cap smallest uniform draws
+            # among rest rows — unbiased in row position, unlike a
+            # Bernoulli draw truncated by prefix. Ties in the k-th draw
+            # break by row index via the same cumulative-count trick as
+            # the top-k side.
+            k_cap = jnp.minimum(jnp.ceil(k_rand),
+                                jnp.maximum(k_rest, 0.0)).astype(jnp.int32)
+            u = jnp.where(rest, jax.random.uniform(key, (n_local,)),
+                          jnp.inf)
+            u_sorted = jnp.sort(u)
+            u_thresh = u_sorted[jnp.clip(k_cap - 1, 0, n_local - 1)]
+            strictly = rest & (u < u_thresh)
+            at_t = rest & (u == u_thresh)
+            need = k_cap - jnp.sum(strictly).astype(jnp.int32)
+            at_rank = jnp.cumsum(at_t.astype(jnp.int32))
+            picked = (strictly | (at_t & (at_rank <= need))) & (k_cap > 0)
             amp = (1.0 - top_rate) / max(other_rate, 1e-12)
             mask_gh = (is_top.astype(jnp.float32)
                        + picked.astype(jnp.float32) * amp)
@@ -752,8 +762,23 @@ class GBDT:
                 skey = jnp.where(sel, iota, iota + n_full)
                 g2 = g if K > 1 else g[:, None]
                 h2 = h if K > 1 else h[:, None]
-                ops = ([skey] + [bins[:, f]
-                                 for f in range(bins.shape[1])]
+                # bin columns ride the sort packed 4-per-uint32: XLA's
+                # multi-operand sort lowering scales badly with operand
+                # count (33 operands at F=28 compiled for >25 min)
+                Fb = bins.shape[1]
+                lane_bits = 8 * bins.dtype.itemsize   # uint8 or uint16
+                per_w = 32 // lane_bits
+                F4 = (Fb + per_w - 1) // per_w
+                b32 = []
+                for w in range(F4):
+                    word = jnp.zeros(n_full, jnp.uint32)
+                    for j in range(per_w):
+                        f = per_w * w + j
+                        if f < Fb:
+                            word = word | (bins[:, f].astype(jnp.uint32)
+                                           << (lane_bits * j))
+                    b32.append(word)
+                ops = ([skey] + b32
                        + [g2[:, k] for k in range(K)]
                        + [h2[:, k] for k in range(K)]
                        + [mask_gh, mask_count])
@@ -761,10 +786,15 @@ class GBDT:
                                           is_stable=False)
                 cut = [o[:n_sub] for o in sorted_ops]
                 lane = cut[0] < n_full
-                Fb = bins.shape[1]
-                bins_c = jnp.stack(cut[1:1 + Fb], axis=1)
-                g_c = jnp.stack(cut[1 + Fb:1 + Fb + K], axis=1)
-                h_c = jnp.stack(cut[1 + Fb + K:1 + Fb + 2 * K], axis=1)
+                cols = []
+                lane_mask = jnp.uint32((1 << lane_bits) - 1)
+                for f in range(Fb):
+                    w, j = divmod(f, per_w)
+                    cols.append(((cut[1 + w] >> (lane_bits * j))
+                                 & lane_mask).astype(bins.dtype))
+                bins_c = jnp.stack(cols, axis=1)
+                g_c = jnp.stack(cut[1 + F4:1 + F4 + K], axis=1)
+                h_c = jnp.stack(cut[1 + F4 + K:1 + F4 + 2 * K], axis=1)
                 mgh_c = jnp.where(lane, cut[-2], 0.0)
                 mc_c = jnp.where(lane, cut[-1], 0.0)
                 bins_t_c = (bins_c.astype(jnp.int8).T
@@ -1469,9 +1499,14 @@ class GBDT:
             "leaf_value": padded(
                 lambda t: t.leaf_value.astype(np.float32), L, np.float32),
         }
-        if any(t.cat_bitset_bins is not None for t in trees):
-            W = max(t.cat_bitset_bins.shape[1] for t in trees
-                    if t.cat_bitset_bins is not None)
+        force_cat = pad_count > 0 and self.has_categorical
+        if force_cat or any(t.cat_bitset_bins is not None for t in trees):
+            # under shape-stabilizing padding, the bitset width and the
+            # presence of the cat keys must not depend on WHICH trees
+            # were drawn, or the consumer jit recompiles per drop set
+            W = ((self.B + 31) // 32 if force_cat else
+                 max(t.cat_bitset_bins.shape[1] for t in trees
+                     if t.cat_bitset_bins is not None))
             bs = np.zeros((n_pad, Ln, W), dtype=np.uint32)
             for i, t in enumerate(trees):
                 if t.cat_bitset_bins is not None:
