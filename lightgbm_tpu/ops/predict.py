@@ -31,40 +31,85 @@ def tree_predict_binned(tree: Dict[str, jax.Array], bins: jax.Array,
     Returns:
       (leaf_value per row ``[n]`` float32, leaf index per row ``[n]`` int32)
     """
-    n = bins.shape[0]
+    n, F = bins.shape
     num_leaves = tree["num_leaves"]
     # node >= 0: internal node index; node < 0: ~leaf
     node0 = jnp.where(num_leaves > 1, jnp.zeros(n, jnp.int32),
                       jnp.full(n, -1, jnp.int32))
+
+    # TPU note: per-row gathers from the per-node tables run on the
+    # scalar unit (~9 ms per gather per Mrow — 5-6 of them per depth
+    # level made a 1M-row traversal cost ~300 ms). Instead the node
+    # attributes are packed into a [Ln, C] matrix contracted against
+    # the [n, Ln] node-membership one-hot each level — all values
+    # (feature ids, bin thresholds, child links, 16-bit bitset halves)
+    # are small integers, exact in f32 at HIGHEST precision.
+    sf = tree["split_feature"].astype(jnp.int32)
+    Ln = sf.shape[0]
+    node_nan_bin = jnp.where(feat_has_nan[sf],
+                             feat_num_bin[sf] - 1, -1)   # [Ln]
+    has_cat = "is_cat" in tree
+    attr_cols = [sf.astype(jnp.float32),
+                 tree["threshold_bin"].astype(jnp.float32),
+                 tree["default_left"].astype(jnp.float32),
+                 node_nan_bin.astype(jnp.float32),
+                 tree["left_child"].astype(jnp.float32),
+                 tree["right_child"].astype(jnp.float32)]
+    if has_cat:
+        bs = tree["cat_bitset"]                          # [Ln, W]
+        W = bs.shape[1]
+        attr_cols.append(tree["is_cat"].astype(jnp.float32))
+        attr_cols.extend(jnp.moveaxis(
+            (bs & jnp.uint32(0xFFFF)).astype(jnp.float32), 1, 0))
+        attr_cols.extend(jnp.moveaxis(
+            (bs >> jnp.uint32(16)).astype(jnp.float32), 1, 0))
+    packed = jnp.stack(attr_cols, axis=1)                # [Ln, C]
+    node_ids = jnp.arange(Ln, dtype=jnp.int32)
+    col_ids = jnp.arange(F, dtype=jnp.int32)
 
     def cond(node):
         return jnp.any(node >= 0)
 
     def body(node):
         nd = jnp.maximum(node, 0)
-        feat = tree["split_feature"][nd]
-        thr = tree["threshold_bin"][nd]
-        dleft = tree["default_left"][nd]
-        col = jnp.take_along_axis(bins, feat[:, None].astype(jnp.int32),
-                                  axis=1)[:, 0].astype(jnp.int32)
-        missing = feat_has_nan[feat] & (col == feat_num_bin[feat] - 1)
-        go_left = jnp.where(missing, dleft, col <= thr)
-        if "is_cat" in tree:
+        oh = (nd[:, None] == node_ids[None, :]).astype(jnp.float32)
+        attr = jax.lax.dot_general(
+            oh, packed, dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)         # [n, C]
+        feat_r = attr[:, 0].astype(jnp.int32)
+        thr_r = attr[:, 1].astype(jnp.int32)
+        dl_r = attr[:, 2] > 0.5
+        nan_r = attr[:, 3].astype(jnp.int32)
+        oh_f = feat_r[:, None] == col_ids[None, :]
+        col = jnp.sum(jnp.where(oh_f, bins.astype(jnp.int32), 0), axis=1)
+        go_left = jnp.where(col == nan_r, dl_r, col <= thr_r)
+        if has_cat:
             # categorical: bin-membership test in the node's bitset
             # (bin 0 / unseen categories miss every bitset -> right)
-            bitset = tree["cat_bitset"][nd]            # [n, W]
-            word = jnp.take_along_axis(
-                bitset, (col >> 5)[:, None], axis=1)[:, 0]
+            oh_w = ((col >> 5)[:, None]
+                    == jnp.arange(W, dtype=jnp.int32)[None, :])
+            lo16 = jnp.sum(jnp.where(oh_w, attr[:, 7:7 + W], 0.0),
+                           axis=1).astype(jnp.uint32)
+            hi16 = jnp.sum(jnp.where(oh_w, attr[:, 7 + W:7 + 2 * W],
+                                     0.0), axis=1).astype(jnp.uint32)
+            word = lo16 | (hi16 << jnp.uint32(16))
             cat_left = ((word >> (col & 31).astype(jnp.uint32))
                         & jnp.uint32(1)) > 0
-            go_left = jnp.where(tree["is_cat"][nd], cat_left, go_left)
-        nxt = jnp.where(go_left, tree["left_child"][nd],
-                        tree["right_child"][nd])
+            go_left = jnp.where(attr[:, 6] > 0.5, cat_left, go_left)
+        nxt = jnp.where(go_left, attr[:, 4], attr[:, 5]) \
+            .astype(jnp.int32)
         return jnp.where(node >= 0, nxt, node)
 
     node = jax.lax.while_loop(cond, body, node0)
     leaf = (-node - 1).astype(jnp.int32)
-    return tree["leaf_value"][leaf], leaf
+    L = tree["leaf_value"].shape[0]
+    oh_leaf = (leaf[:, None]
+               == jnp.arange(L, dtype=jnp.int32)[None, :])
+    vals = jax.lax.dot_general(
+        oh_leaf.astype(jnp.float32), tree["leaf_value"][:, None],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)[:, 0]
+    return vals, leaf
 
 
 def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
