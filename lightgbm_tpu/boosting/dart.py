@@ -92,8 +92,10 @@ class DART(GBDT):
             stacked, class_idx = self._stack_model_list(
                 model_idx, pad_count=pad_count,
                 pad_leaves=self.config.num_leaves)
+            # LOGICAL bins: under EFB the resident train matrix is the
+            # bundled physical layout, but tree thresholds are logical
             drop_contrib, _ = forest_predict_binned(
-                stacked, self.data.bins, self.feat_num_bin,
+                stacked, self._logical_bins(), self.feat_num_bin,
                 self.feat_has_nan, class_idx, K)
             self.score = self.score - drop_contrib
             for vi, dd in enumerate(self.valid_data):

@@ -391,17 +391,21 @@ class GBDT:
 
     def _logical_bins(self) -> jnp.ndarray:
         """The LOGICAL binned train matrix for tree traversal (score
-        rebuilds). Under EFB the resident matrix is the bundled physical
-        one, so rebuild the logical layout on demand (rare: rollback /
-        continuation)."""
+        rebuilds, DART dropped-tree recomputation). Under EFB the
+        resident matrix is the bundled physical one, so the logical
+        layout is rebuilt on first use and cached — DART needs it every
+        iteration, so under EFB+DART both layouts stay resident."""
         if not self.has_bundles:
             return self.data.bins
-        binned = self.train_set.binned
-        if self.data.n_pad > binned.shape[0]:
-            binned = np.concatenate(
-                [binned, np.zeros((self.data.n_pad - binned.shape[0],
-                                   binned.shape[1]), binned.dtype)])
-        return self.data._place(binned, extra_dims=2)
+        if getattr(self, "_logical_bins_cache", None) is None:
+            binned = self.train_set.binned
+            if self.data.n_pad > binned.shape[0]:
+                binned = np.concatenate(
+                    [binned, np.zeros((self.data.n_pad - binned.shape[0],
+                                       binned.shape[1]), binned.dtype)])
+            self._logical_bins_cache = self.data._place(binned,
+                                                        extra_dims=2)
+        return self._logical_bins_cache
 
     def _load_forest(self, init_forest) -> None:
         """Continuation: adopt a loaded HostModel's trees and fold their

@@ -43,9 +43,15 @@ def tree_predict_binned(tree: Dict[str, jax.Array], bins: jax.Array,
     # attributes are packed into a [Ln, C] matrix contracted against
     # the [n, Ln] node-membership one-hot each level — all values
     # (feature ids, bin thresholds, child links, 16-bit bitset halves)
-    # are small integers, exact in f32 at HIGHEST precision.
+    # are small integers, exact in f32 at HIGHEST precision. The
+    # one-hot operand is O(n * Ln), so very wide trees fall back to
+    # the O(n)-memory gather formulation (same cutoff as the score
+    # update in boosting/gbdt.py).
     sf = tree["split_feature"].astype(jnp.int32)
     Ln = sf.shape[0]
+    if Ln > 512:
+        return _tree_predict_binned_gather(tree, bins, feat_num_bin,
+                                           feat_has_nan, node0)
     node_nan_bin = jnp.where(feat_has_nan[sf],
                              feat_num_bin[sf] - 1, -1)   # [Ln]
     has_cat = "is_cat" in tree
@@ -110,6 +116,39 @@ def tree_predict_binned(tree: Dict[str, jax.Array], bins: jax.Array,
         dimension_numbers=(((1,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST)[:, 0]
     return vals, leaf
+
+
+def _tree_predict_binned_gather(tree, bins, feat_num_bin, feat_has_nan,
+                                node0):
+    """O(n)-memory per-row gather traversal — the fallback for trees too
+    wide for the one-hot matmul formulation (num_leaves > 512)."""
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        nd = jnp.maximum(node, 0)
+        feat = tree["split_feature"][nd]
+        thr = tree["threshold_bin"][nd]
+        dleft = tree["default_left"][nd]
+        col = jnp.take_along_axis(bins, feat[:, None].astype(jnp.int32),
+                                  axis=1)[:, 0].astype(jnp.int32)
+        missing = feat_has_nan[feat] & (col == feat_num_bin[feat] - 1)
+        go_left = jnp.where(missing, dleft, col <= thr)
+        if "is_cat" in tree:
+            bitset = tree["cat_bitset"][nd]            # [n, W]
+            word = jnp.take_along_axis(
+                bitset, (col >> 5)[:, None], axis=1)[:, 0]
+            cat_left = ((word >> (col & 31).astype(jnp.uint32))
+                        & jnp.uint32(1)) > 0
+            go_left = jnp.where(tree["is_cat"][nd], cat_left, go_left)
+        nxt = jnp.where(go_left, tree["left_child"][nd],
+                        tree["right_child"][nd])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node0)
+    leaf = (-node - 1).astype(jnp.int32)
+    return tree["leaf_value"][leaf], leaf
 
 
 def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,

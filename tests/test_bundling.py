@@ -154,3 +154,27 @@ def test_sparse_input_binning_matches_dense():
                       num_boost_round=6)
     np.testing.assert_allclose(bst_d.predict(X), bst_s.predict(X),
                                rtol=0, atol=0)
+
+
+def test_dart_under_efb_matches_unbundled():
+    """DART's dropped-tree recomputation must traverse LOGICAL bins —
+    the resident train matrix under EFB is the bundled physical layout
+    (regression test: it used to read self.data.bins directly)."""
+    rng = np.random.default_rng(11)
+    n = 4000
+    dense = rng.normal(size=(n, 4))
+    oh = (rng.integers(0, 6, size=n)[:, None]
+          == np.arange(5)[None, :]).astype(float)
+    X = np.concatenate([dense, oh], axis=1)
+    y = (X[:, 0] + X[:, 4] > 0.3).astype(float)
+    params = {"objective": "binary", "boosting": "dart", "num_leaves": 15,
+              "drop_rate": 0.3, "skip_drop": 0.0, "verbosity": -1,
+              "learning_rate": 0.3}
+    b_plain = lgb.train({**params, "enable_bundle": False},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    b_efb = lgb.train({**params, "enable_bundle": True},
+                      lgb.Dataset(X, label=y, params={"enable_bundle":
+                                                      True}),
+                      num_boost_round=10)
+    np.testing.assert_allclose(b_efb.predict(X), b_plain.predict(X),
+                               rtol=1e-4, atol=1e-5)
