@@ -78,3 +78,66 @@ def test_compact_engine_flag_and_fallbacks():
                         "data_sample_strategy": "goss",
                         "tpu_goss_compact": True, "verbosity": -1}), ds2)
     assert not eng2._use_goss_compact
+
+
+def test_goss_selects_exact_counts():
+    """GOSS parity property (goss.hpp): exactly round(a*n_valid) top
+    rows and exactly round(b*n_valid) random rows are selected every
+    iteration, even with heavily tied |g*h| metrics."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    rng = np.random.default_rng(5)
+    n = 4096
+    X = rng.normal(size=(n, 4))
+    # many duplicated rows -> tied gradients/hessians
+    X[2000:] = X[:2096]
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    cfg = Config({"objective": "binary", "num_leaves": 7,
+                  "data_sample_strategy": "goss", "learning_rate": 0.5,
+                  "top_rate": 0.25, "other_rate": 0.15, "verbosity": -1})
+    eng = GBDT(cfg, ds)
+    for _ in range(3):
+        eng.train_one_iter()
+    n_valid = int(np.asarray(eng.data.valid_mask).sum())
+    k_top = int(round(0.25 * n_valid))
+    k_rand = int(round(0.15 * n_valid))   # engine rounds, then caps
+    # engine-level check: run a GOSS iteration and inspect leaf counts
+    eng.train_one_iter()
+    t = eng.models[-1]
+    total = float(np.sum(t.leaf_count))
+    assert total == k_top + k_rand, (total, k_top, k_rand)
+
+
+def test_wide_tree_matmul_and_gather_traversals_agree():
+    """The num_leaves>512 gather fallback and the matmul formulation
+    must route rows identically (incl. NaN-bin default direction)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.predict import (tree_predict_binned,
+                                          _tree_predict_binned_gather)
+    rng = np.random.default_rng(7)
+    n, F, L = 5000, 6, 64
+    bins = jnp.asarray(rng.integers(0, 16, size=(n, F)).astype(np.uint8))
+    # random consistent tree: node i children either deeper nodes or
+    # leaves; build a left-spine tree with random features/thresholds
+    lc = np.concatenate([np.arange(1, L - 1), [-L]]).astype(np.int32)
+    rc = (-np.arange(1, L)).astype(np.int32)
+    tree = {
+        "num_leaves": jnp.asarray(L),
+        "split_feature": jnp.asarray(
+            rng.integers(0, F, L - 1).astype(np.int32)),
+        "threshold_bin": jnp.asarray(
+            rng.integers(0, 15, L - 1).astype(np.int32)),
+        "default_left": jnp.asarray(rng.random(L - 1) < 0.5),
+        "left_child": jnp.asarray(lc),
+        "right_child": jnp.asarray(rc),
+        "leaf_value": jnp.asarray(rng.normal(size=L).astype(np.float32)),
+    }
+    fnb = jnp.full(F, 16, jnp.int32)
+    fhn = jnp.asarray(rng.random(F) < 0.5)   # some NaN-bin features
+    v1, l1 = tree_predict_binned(tree, bins, fnb, fhn)
+    node0 = jnp.zeros(n, jnp.int32)
+    v2, l2 = _tree_predict_binned_gather(tree, bins, fnb, fhn, node0)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=0,
+                               atol=0)
