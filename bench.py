@@ -1,31 +1,34 @@
-"""Benchmark: boosting iters/sec on synthetic Higgs-1M-like data.
+"""Benchmark: boosting iters/sec on synthetic Higgs-like data.
 
 Driver contract: print ONE JSON line
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
 
 Config mirrors BASELINE.json's flagship: binary classification, 28 dense
 features, num_leaves=127, max_bin=255. The dataset is synthesized (no
-network in this environment; Higgs itself is a download) at 1M rows —
-matching the "Higgs-1M CPU hist baseline" config shape.
+network in this environment; Higgs itself is a download). Default 1M
+rows — matching the "Higgs-1M CPU hist baseline" config shape; pass
+``--rows 10000000`` for the flagship Higgs-10M shape (BASELINE.json's
+headline metric), which also reports binning time and peak HBM.
+
+Extra flags (all optional; defaults reproduce the driver run):
+  --rows N --holdout N --iters N --leaf-batch K --hist-mode pool|rebuild
+  --quant (use_quantized_grad) --goss (data_sample_strategy=goss)
 
 vs_baseline: BASELINE.md holds NO verified reference numbers (empty
 mount). We compare against 1.0 iters/sec — the ballpark of CPU
 hist-LightGBM on Higgs-1M-class data per BASELINE.md's unverified
 recollection table — so vs_baseline > 1 means faster than CPU LightGBM.
 """
+import argparse
 import json
 import sys
 import time
 
 import numpy as np
 
-N_ROWS = int(1e6)
-N_HOLDOUT = 100_000
 N_FEATURES = 28
 NUM_LEAVES = 127
 MAX_BIN = 255
-WARMUP_ITERS = 40     # one full fused chunk (tpu_fuse_iters default)
-BENCH_ITERS = 40
 CPU_LIGHTGBM_BASELINE_ITERS_PER_SEC = 1.0  # UNVERIFIED, see BASELINE.md
 
 
@@ -40,43 +43,85 @@ def synth_higgs(n, f, seed=0):
     return X.astype(np.float64), y
 
 
+def peak_hbm_gib():
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        return None if peak is None else round(peak / 2**30, 2)
+    except Exception:
+        return None
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--holdout", type=int, default=100_000)
+    ap.add_argument("--iters", type=int, default=40)
+    # warmup must match the timed chunk length so the fused scan is
+    # compiled exactly once, outside the timed region
+    ap.add_argument("--warmup", type=int, default=None)
+    ap.add_argument("--leaf-batch", type=int, default=None)
+    ap.add_argument("--hist-mode", choices=["pool", "rebuild"],
+                    default=None)
+    ap.add_argument("--quant", action="store_true")
+    ap.add_argument("--goss", action="store_true")
+    args = ap.parse_args()
+
     import lightgbm_tpu as lgb
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
 
-    X, y = synth_higgs(N_ROWS + N_HOLDOUT, N_FEATURES)
-    X, X_ho = X[:N_ROWS], X[N_ROWS:]
-    y, y_ho = y[:N_ROWS], y[N_ROWS:]
+    X, y = synth_higgs(args.rows + args.holdout, N_FEATURES)
+    X, X_ho = X[:args.rows], X[args.rows:]
+    y, y_ho = y[:args.rows], y[args.rows:]
     t_bin = time.time()
     ds = lgb.Dataset(X, label=y)
-    cfg = Config({"objective": "binary", "num_leaves": NUM_LEAVES,
-                  "max_bin": MAX_BIN, "learning_rate": 0.1,
-                  "verbosity": -1})
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "max_bin": MAX_BIN, "learning_rate": 0.1,
+              "verbosity": -1}
+    if args.leaf_batch is not None:
+        params["tpu_leaf_batch"] = args.leaf_batch
+    if args.hist_mode is not None:
+        params["tpu_hist_mode"] = args.hist_mode
+    if args.quant:
+        params["use_quantized_grad"] = True
+    if args.goss:
+        params["data_sample_strategy"] = "goss"
+    cfg = Config(params)
     eng = GBDT(cfg, ds)
     bin_time = time.time() - t_bin
 
     # warmup (jit compile + cache); same chunk length as the timed run so
     # the fused scan is compiled exactly once
-    eng.train_chunk(WARMUP_ITERS)
+    eng.train_chunk(args.iters if args.warmup is None else args.warmup)
     import jax
     jax.block_until_ready(eng.score)
 
     t0 = time.time()
-    eng.train_chunk(BENCH_ITERS)
+    eng.train_chunk(args.iters)
     jax.block_until_ready(eng.score)
     dt = time.time() - t0
-    iters_per_sec = BENCH_ITERS / dt
+    iters_per_sec = args.iters / dt
 
     # held-out AUC as the quality guard (train-AUC would reward overfit)
     from lightgbm_tpu.metric import AUCMetric
     pred = eng.predict(X_ho)
     auc = AUCMetric(cfg).eval(pred, y_ho, None)[0][1]
 
+    shape_tag = ("higgs1m-synth" if args.rows == 1_000_000
+                 else f"higgs{args.rows // 1_000_000}m-synth"
+                 if args.rows % 1_000_000 == 0
+                 else f"higgs{args.rows}-synth")
+    extras = ""
+    peak = peak_hbm_gib()
+    if peak is not None:
+        extras += f"; peak_hbm_gib={peak}"
     result = {
         "metric": ("boosting_iters_per_sec "
-                   f"(higgs1m-synth nl={NUM_LEAVES} mb={MAX_BIN}; "
-                   f"holdout_auc={auc:.4f}; binning_s={bin_time:.1f})"),
+                   f"({shape_tag} nl={NUM_LEAVES} mb={MAX_BIN}; "
+                   f"holdout_auc={auc:.4f}; binning_s={bin_time:.1f}"
+                   f"{extras})"),
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
         "vs_baseline": round(

@@ -59,7 +59,34 @@ def run(argv: Optional[List[str]] = None) -> int:
     num_round = int(params.pop("num_iterations",
                                params.pop("num_boost_round", 100)))
 
-    if task in ("train", "refit"):
+    if task in ("refit", "refit_tree"):
+        # Application task=refit (gbdt.cpp::RefitTree): RE-FIT the loaded
+        # model's existing leaf values on the new data — does NOT add
+        # trees (that is task=train with input_model= continuation)
+        if input_model is None:
+            log.fatal("task=refit needs input_model=FILE")
+        if data_path is None:
+            log.fatal("No refit data: pass data=FILE")
+        from .io.text_loader import load_text
+        loaded = load_text(
+            data_path,
+            label_column=params.get("label_column", "auto"),
+            weight_column=params.get("weight_column"),
+            group_column=params.get("group_column"),
+            ignore_column=params.get("ignore_column"))
+        if loaded.label is None:
+            log.fatal("task=refit data has no label column")
+        bst = Booster(model_file=input_model, params=dict(params))
+        decay = params.get("refit_decay_rate")
+        new_bst = bst.refit(loaded.X, loaded.label, weight=loaded.weight,
+                            group=loaded.group,
+                            decay_rate=(None if decay is None
+                                        else float(decay)))
+        new_bst.save_model(output_model)
+        log.info(f"Finished refit; model saved to {output_model}")
+        return 0
+
+    if task == "train":
         if data_path is None:
             log.fatal("No training data: pass data=FILE")
         ds = Dataset(data_path, params=dict(params))
@@ -101,8 +128,20 @@ def run(argv: Optional[List[str]] = None) -> int:
             # feature count (the reference pads parsed rows the same way)
             X = np.concatenate(
                 [X, np.zeros((len(X), n_feat - X.shape[1]))], axis=1)
+        elif X.shape[1] > n_feat:
+            if coerce_bool(params.get("predict_disable_shape_check",
+                                      False)):
+                X = X[:, :n_feat]
+            else:
+                log.fatal(f"The number of features in data ({X.shape[1]})"
+                          f" is not the same as it was in training data "
+                          f"({n_feat}); set predict_disable_shape_check="
+                          f"true to ignore")
+        n_iter_p = int(params.get("num_iteration_predict", -1))
         pred = bst.predict(
             X,
+            start_iteration=int(params.get("start_iteration_predict", 0)),
+            num_iteration=(None if n_iter_p <= 0 else n_iter_p),
             raw_score=coerce_bool(params.get("predict_raw_score", False)),
             pred_leaf=coerce_bool(params.get("predict_leaf_index", False)),
             pred_contrib=coerce_bool(params.get("predict_contrib",
@@ -115,8 +154,18 @@ def run(argv: Optional[List[str]] = None) -> int:
     if task == "convert_model":
         if input_model is None:
             log.fatal("task=convert_model needs input_model=FILE")
-        Booster(model_file=input_model).save_model(
-            params.get("convert_model", "model_out.txt"))
+        bst = Booster(model_file=input_model)
+        out = params.get("convert_model", "gbdt_prediction.cpp")
+        lang = str(params.get("convert_model_language", "")).lower()
+        if lang in ("", "cpp", "c", "c++"):
+            # the reference's convert_model emits standalone C++
+            # if-else prediction code (application.cpp task taxonomy;
+            # cpp is the only — and therefore default — target)
+            with open(out, "w") as f:
+                f.write(bst.model_to_c())
+        else:
+            log.fatal(f"Unknown convert_model_language {lang!r} "
+                      f"(only cpp is supported)")
         return 0
 
     if task == "save_binary":

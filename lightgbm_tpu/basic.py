@@ -234,9 +234,14 @@ class Booster:
                         start_iteration: int = 0,
                         importance_type: str = "split") -> str:
         from .io.model_text import save_model_string
-        if self._from_model is not None:
-            return save_model_string(self._from_model)
-        return save_model_string(self._to_host_model())
+        if (importance_type == "split"
+                and int(self.params.get("saved_feature_importance_type",
+                                        0) or 0) == 1):
+            # config saved_feature_importance_type=1 -> gain importances
+            importance_type = "gain"
+        hm = (self._from_model if self._from_model is not None
+              else self._to_host_model())
+        return save_model_string(hm, importance_type=importance_type)
 
     def save_model(self, filename: str,
                    num_iteration: Optional[int] = None,

@@ -73,6 +73,30 @@ class _DeviceData:
         from ..parallel.mesh import P, put, shard_rows
         axis = mesh.axis_names[0] if mesh is not None else None
 
+        # HBM capacity guard: the dominant device residents are the
+        # row-major bins and (Pallas path) the feature-major bins_t;
+        # per-device share divides by the row shard count. Fail with an
+        # actionable message instead of an opaque device OOM.
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            hbm_limit = stats.get("bytes_limit")
+        except Exception:            # CPU/older runtimes: no stats
+            hbm_limit = None
+        if hbm_limit:
+            need = binned.nbytes * (2 if transposed else 1)
+            # rows (data/voting) or columns (feature-parallel) shard
+            # over every mesh device either way
+            n_dev = mesh.devices.size if mesh is not None else 1
+            per_dev = need // n_dev
+            if per_dev > 0.92 * hbm_limit:
+                from ..utils import log as _log
+                _log.fatal(
+                    f"binned data needs ~{per_dev / 2**30:.1f} GiB per "
+                    f"device but HBM is {hbm_limit / 2**30:.1f} GiB. "
+                    f"Shard rows over more devices "
+                    f"(tree_learner=data), lower max_bin, or drop "
+                    f"features")
+
         def place(a, extra_dims=1):
             if mesh is None:
                 return jnp.asarray(a)
@@ -133,9 +157,13 @@ class GBDT:
                 and jax.device_count() > 1):
             from ..parallel.mesh import (create_data_mesh,
                                          create_feature_mesh)
-            self.mesh = (create_feature_mesh()
+            # tpu_mesh_shape: cap the mesh to the first N devices
+            # ("" = all visible devices)
+            nd = (int(config.tpu_mesh_shape)
+                  if str(config.tpu_mesh_shape).strip() else None)
+            self.mesh = (create_feature_mesh(nd)
                          if config.tree_learner == "feature"
-                         else create_data_mesh())
+                         else create_data_mesh(nd))
         if self.mesh is not None and config.tree_learner == "serial":
             self.mesh = None
         self.learner_type = config.tree_learner if self.mesh is not None \
@@ -165,9 +193,11 @@ class GBDT:
         self._pos_state = None
         if getattr(self.objective, "has_pos_state", False):
             if self.mesh is not None:
-                log.fatal("lambdarank_unbiased is not supported with "
-                          "distributed tree_learner yet; use the serial "
-                          "learner or disable lambdarank_unbiased")
+                log.fatal("position debiasing (a `position` field, or "
+                          "lambdarank_unbiased=true) is not supported "
+                          "with distributed tree_learner yet; drop the "
+                          "position field / flag or use the serial "
+                          "learner")
             self._pos_state = self.objective.init_pos_state()
         self.metrics: List[Metric] = metrics_for_config(config)
         self.num_class = config.num_tree_per_iteration
@@ -273,6 +303,19 @@ class GBDT:
         self.has_monotone = bool(np.any(mono != 0))
         self.feat_mono = jnp.asarray(mono) if self.has_monotone else None
 
+        # feature_contri (config_auto.cpp feature_contri, the "fp"
+        # feature-penalty aliases): per-feature split-gain multipliers,
+        # given by ORIGINAL feature index, remapped to used features
+        fc = list(config.feature_contri or [])
+        self.has_contri = bool(fc) and any(float(c) != 1.0 for c in fc)
+        self.feat_contri = None
+        if self.has_contri:
+            arr = np.ones(self.F_pad, dtype=np.float32)
+            for i, f in enumerate(self.train_set.used_features):
+                if f < len(fc):
+                    arr[i] = float(fc[f])
+            self.feat_contri = jnp.asarray(arr)
+
         # interaction constraints ([G, F_pad] bool over used features)
         from ..config import parse_interaction_constraints
         groups_spec = parse_interaction_constraints(
@@ -308,10 +351,8 @@ class GBDT:
         # coupled per-feature penalty charged until a feature first
         # enters the model (host-tracked, device array refreshed on use)
         coupled = list(config.cegb_penalty_feature_coupled or [])
-        if any(config.cegb_penalty_feature_lazy or []):
-            log.warning("cegb_penalty_feature_lazy is not implemented "
-                        "(per-row feature-acquisition tracking); use "
-                        "cegb_penalty_feature_coupled")
+        # (cegb_penalty_feature_lazy warns centrally in config.py's
+        # UNIMPLEMENTED_PARAMS table)
         self.has_cegb = bool(
             config.cegb_penalty_split > 0 or any(coupled))
         self._cegb_coupled = None
@@ -353,8 +394,33 @@ class GBDT:
         if label_np is not None and self.fobj is None \
                 and (init_forest is None or config.boosting == "rf"):
             if self.num_class == 1:
-                self.init_scores[0] = self.objective.init_score(
-                    label_np, self.train_set.metadata.weight)
+                w_np = self.train_set.metadata.weight
+                if jax.process_count() > 1 and config.boost_from_average:
+                    # multi-host: each process holds only its row shard;
+                    # sync the mean statistic across processes (the
+                    # reference's Network::GlobalSyncUpByMean)
+                    stats = self.objective.init_mean_stats(label_np, w_np)
+                    if stats is None:
+                        log.warning(
+                            "boost_from_average for this objective is a "
+                            "percentile statistic that cannot be synced "
+                            "across hosts; using this process's local "
+                            "shard only")
+                        self.init_scores[0] = self.objective.init_score(
+                            label_np, w_np)
+                    else:
+                        from jax.experimental import multihost_utils
+                        tot = np.asarray(
+                            multihost_utils.process_allgather(
+                                jnp.asarray(stats, jnp.float64)
+                                if jax.config.jax_enable_x64
+                                else jnp.asarray(stats, jnp.float32)))
+                        self.init_scores[0] = self.objective.init_from_mean(
+                            float(tot[:, 0].sum()) / max(
+                                float(tot[:, 1].sum()), 1e-30))
+                else:
+                    self.init_scores[0] = self.objective.init_score(
+                        label_np, w_np)
         self.score = self._init_score_tile(self.data)
         if init_forest is not None:
             self._load_forest(init_forest)
@@ -386,6 +452,11 @@ class GBDT:
         """Device [n_pad, K] tile of init scores + dataset init_score."""
         s0 = np.tile(self.init_scores.astype(np.float32), (dd.n_pad, 1))
         if dd.init_score is not None:
+            m = dd.init_score.size
+            if m not in (dd.n, dd.n * self.num_class):
+                log.fatal(f"Length of init_score ({m}) does not match "
+                          f"number of data ({dd.n}) or number of data * "
+                          f"num_class ({dd.n * self.num_class})")
             s0[:dd.n] += dd.init_score.reshape(dd.n, -1).astype(np.float32)
         return dd._place(s0, extra_dims=2)
 
@@ -513,6 +584,10 @@ class GBDT:
             top_k=config.top_k,
             feature_axis=(self.axis if self._shard_features else ""),
             has_monotone=self.has_monotone,
+            monotone_intermediate=(
+                str(config.monotone_constraints_method).lower()
+                in ("intermediate", "advanced")),
+            monotone_penalty=config.monotone_penalty,
             has_interaction=self.has_interaction,
             has_bundles=self.has_bundles,
             hist_rebuild=(config.tpu_hist_mode == "rebuild"),
@@ -520,6 +595,10 @@ class GBDT:
             has_cegb=self.has_cegb,
             cegb_tradeoff=config.cegb_tradeoff,
             cegb_penalty_split=config.cegb_penalty_split,
+            path_smooth=config.path_smooth,
+            extra_trees=config.extra_trees,
+            extra_seed=config.extra_seed,
+            has_contri=self.has_contri,
         )
 
     # ------------------------------------------------------------------
@@ -548,6 +627,7 @@ class GBDT:
         use_quant = bool(self.config.use_quantized_grad)
         qbins = max(2, int(self.config.num_grad_quant_bins))
         renew_quant = bool(self.config.quant_train_renew_leaf)
+        use_sr = bool(self.config.stochastic_rounding)
         glevels = max(qbins // 2, 1)
         hlevels = max(qbins - 1, 1)
 
@@ -559,7 +639,9 @@ class GBDT:
                 hmax = jax.lax.pmax(hmax, gcfg.axis_name)
             scale_g = jnp.maximum(gmax / glevels, 1e-30)
             scale_h = jnp.maximum(hmax / hlevels, 1e-30)
-            if qkey is not None:
+            if qkey is not None and use_sr:
+                # stochastic_rounding=false -> deterministic nearest
+                # rounding (gradient_discretizer semantics)
                 kg, kh = jax.random.split(qkey)
                 ng = jax.random.uniform(kg, gk_m.shape,
                                         minval=-0.5, maxval=0.5)
@@ -603,7 +685,7 @@ class GBDT:
                     bundle=self._bundle_dev, chan_scale=chan_scale,
                     node_key=(None if qkey is None
                               else jax.random.fold_in(qkey, 0xB14D + k)),
-                    cegb_pen=cegb_pen)
+                    cegb_pen=cegb_pen, contri=self.feat_contri)
                 if use_quant and renew_quant:
                     # re-derive leaf outputs from FULL-precision sums
                     # (quant_train_renew_leaf)
@@ -654,8 +736,96 @@ class GBDT:
                             allowed, qkey=jax.random.fold_in(key, 0x9e37),
                             cegb_pen=cegb_pen)
 
+        # ---- tpu_debug: checkify validation pass (SURVEY.md §5) --------
+        # a separate jitted checkify program (cheap: gradients only, no
+        # tree growth) so the hot step stays checkify-free
+        self._debug_check = None
+        if bool(self.config.tpu_debug):
+            from jax.experimental import checkify
+
+            def _dbg_impl(score, label, weight, key, pos_state):
+                n_bad_s = jnp.sum(~jnp.isfinite(score))
+                checkify.check(
+                    n_bad_s == 0,
+                    "model scores contain {n} non-finite value(s) — "
+                    "non-finite labels/init_score, or a previous "
+                    "iteration diverged (try a lower learning_rate)",
+                    n=n_bad_s)
+                if getattr(obj, "has_pos_state", False):
+                    s = score[:, 0] if K == 1 else score
+                    g, h, _ = obj.get_gradients(s, label, weight,
+                                                pos_state=pos_state)
+                else:
+                    g, h = gradients(score, label, weight, key)
+                n_bad_g = jnp.sum(~jnp.isfinite(g))
+                n_bad_h = jnp.sum(~jnp.isfinite(h))
+                n_neg_h = jnp.sum(h < 0)
+                checkify.check(
+                    n_bad_g == 0,
+                    "objective produced {n} non-finite gradient "
+                    "value(s) — check labels/init_score/custom fobj",
+                    n=n_bad_g)
+                checkify.check(
+                    n_bad_h == 0,
+                    "objective produced {n} non-finite hessian "
+                    "value(s) — check labels/init_score/custom fobj",
+                    n=n_bad_h)
+                checkify.check(
+                    n_neg_h == 0,
+                    "objective produced {n} negative hessian value(s) "
+                    "— leaf outputs would be unbounded", n=n_neg_h)
+                return n_bad_g
+
+            self._debug_check = jax.jit(
+                checkify.checkify(_dbg_impl,
+                                  errors=checkify.user_checks))
+            # oob-bin audit (host-side, once): every stored bin id must
+            # be < the feature's bin count. (Skipped under EFB — the
+            # physical bundle columns use offset bin spaces that the
+            # logical feat_num_bin does not describe.)
+            if not self.has_bundles and len(self.train_set.binned):
+                nb_host = np.asarray(self.feat_num_bin)
+                binned_chk = self.train_set.binned
+                F_chk = min(binned_chk.shape[1], len(nb_host))
+                col_max = binned_chk[:, :F_chk].max(axis=0)
+                bad = np.nonzero(col_max >= nb_host[:F_chk])[0]
+                if len(bad):
+                    log.fatal(f"tpu_debug: out-of-bounds bin ids in "
+                              f"feature column(s) {bad.tolist()[:8]} "
+                              f"(max bin {col_max[bad[0]]} >= num_bin "
+                              f"{int(nb_host[bad[0]])}) — corrupt "
+                              f"binned data or mismatched bin mappers")
+
         top_rate = float(self.config.top_rate)
         other_rate = float(self.config.other_rate)
+        # goss.hpp truncates the DOUBLE product (static_cast<data_size_t>
+        # of rate * cnt); an f32 floor on device can differ by one when
+        # the product lands within an f32 ulp of an integer (e.g.
+        # 0.35*180). The per-shard valid counts are static (padding mask
+        # only — GOSS replaces bagging), so the exact counts are
+        # precomputed host-side in double and closed over as constants.
+        _rows_sharded = self.mesh is not None and not self._shard_features
+        # The exact table below assumes this process sees the full row
+        # range (single host); multi-host processes only know their OWN
+        # shard sizes, so they keep the runtime (f32-floor) computation —
+        # layout-correct, at worst one row off the reference's double
+        # truncation near integer products.
+        _goss_exact = jax.process_count() == 1
+        if _rows_sharded:
+            _gsh = self.mesh.devices.size
+            _blk = self.data.n_pad // _gsh
+            _local_valid = [max(0, min(self.data.n - s * _blk, _blk))
+                            for s in range(_gsh)]
+        else:
+            _local_valid = [self.data.n]
+        goss_axis = self.axis if _rows_sharded else None
+        # goss.hpp floors top_k at 1 (std::max(1, top_k)); a shard with
+        # zero valid rows still selects nothing because is_top is masked
+        # by the valid mask
+        goss_k_top_tbl = jnp.asarray(
+            [max(1, int(v * top_rate)) for v in _local_valid], jnp.int32)
+        goss_k_rand_tbl = jnp.asarray(
+            [int(v * other_rate) for v in _local_valid], jnp.int32)
 
         def goss_masks(g, h, valid_mask, key):
             """GOSS (goss.hpp): keep top-a by |g*h|, sample b of the rest,
@@ -667,9 +837,16 @@ class GBDT:
             metric = metric * valid_mask
             n_local = metric.shape[0]
             n_valid = jnp.sum(valid_mask)
-            k_top = jnp.round(top_rate * n_valid).astype(jnp.int32)
+            if _goss_exact:
+                sid = (jax.lax.axis_index(goss_axis)
+                       if goss_axis is not None else 0)
+                k_top = goss_k_top_tbl[sid]
+                k_rand = goss_k_rand_tbl[sid].astype(jnp.float32)
+            else:
+                k_top = jnp.maximum(
+                    jnp.floor(top_rate * n_valid), 1.0).astype(jnp.int32)
+                k_rand = jnp.floor(other_rate * n_valid)
             k_rest = jnp.maximum(n_valid - k_top, 1.0)
-            k_rand = jnp.round(other_rate * n_valid)
             sorted_m = jnp.sort(metric)
             thresh_idx = jnp.clip(n_local - k_top, 0, n_local - 1)
             thresh = sorted_m[thresh_idx]
@@ -683,7 +860,7 @@ class GBDT:
             k_need = k_top - jnp.sum(above).astype(jnp.int32)
             tie = (metric == thresh) & valid
             tie_rank = jnp.cumsum(tie.astype(jnp.int32))
-            is_top = (above | (tie & (tie_rank <= k_need))) & (k_top > 0)
+            is_top = above | (tie & (tie_rank <= k_need))
             rest = valid & ~is_top
             # EXACT-size uniform sample of the rest (goss.hpp samples a
             # fixed-size subset): keep the k_cap smallest uniform draws
@@ -691,8 +868,7 @@ class GBDT:
             # Bernoulli draw truncated by prefix. Ties in the k-th draw
             # break by row index via the same cumulative-count trick as
             # the top-k side.
-            k_cap = jnp.minimum(jnp.ceil(k_rand),
-                                jnp.maximum(k_rest, 0.0)).astype(jnp.int32)
+            k_cap = jnp.minimum(k_rand, k_rest).astype(jnp.int32)
             u = jnp.where(rest, jax.random.uniform(key, (n_local,)),
                           jnp.inf)
             u_sorted = jnp.sort(u)
@@ -822,7 +998,7 @@ class GBDT:
                         groups=self.interaction_groups,
                         chan_scale=chan_scale,
                         node_key=jax.random.fold_in(qkey, 0xB14D + k),
-                        cegb_pen=cegb_pen)
+                        cegb_pen=cegb_pen, contri=self.feat_contri)
                     # full-data score update by traversal — unsampled
                     # rows need this iteration's tree too
                     vals_full, _ = tree_predict_binned(
@@ -1186,6 +1362,26 @@ class GBDT:
             self.config.data_sample_strategy == "goss" and grad is None
             and self.iter_ >= int(1.0 / max(self.config.learning_rate,
                                             1e-6)))
+        if self._debug_check is not None:
+            from jax.experimental import checkify as _checkify
+            if grad is not None:
+                # custom-fobj grads arrive host-side: validate directly
+                for nm, a in (("gradient", grad), ("hessian", hess)):
+                    bad = int(np.sum(~np.isfinite(np.asarray(a))))
+                    if bad:
+                        log.fatal(
+                            f"tpu_debug at iteration {self.iter_}: "
+                            f"custom fobj produced {bad} non-finite "
+                            f"{nm} value(s)")
+            else:
+                err, _ = self._debug_check(
+                    self.score, self.data.label, self.data.weight, key,
+                    self._pos_state)
+                try:
+                    err.throw()
+                except _checkify.JaxRuntimeError as e:
+                    log.fatal(f"tpu_debug at iteration {self.iter_}: "
+                              f"{e}")
         if grad is not None:
             mask_gh, mask_count = self._bagging_masks()
             g = self._pad_custom(grad)
@@ -1367,7 +1563,8 @@ class GBDT:
         return (self.fobj is None and not renews and not use_bagging
                 and c.feature_fraction >= 1.0 and not self.valid_data
                 and self._cegb_coupled is None and not self.linear_tree
-                and not c.tpu_debug_checks and self._pos_state is None)
+                and not c.tpu_debug_checks and not c.tpu_debug
+                and self._pos_state is None)
 
     def train_chunk(self, n_iters: int) -> None:
         """Run ``n_iters`` boosting iterations in one device dispatch
@@ -1572,16 +1769,39 @@ class GBDT:
                                     start_iteration=start_iteration,
                                     num_iteration=num_iteration,
                                     pred_leaf=pred_leaf)
-        X = Dataset._to_matrix(X)
         ds = self.train_set
-        if X.shape[1] != ds.num_total_features:
-            log.fatal(
-                f"The number of features in data ({X.shape[1]}) is not the "
-                f"same as it was in training data ({ds.num_total_features})")
-        cols = [ds.bin_mappers[f].values_to_bins(X[:, f])
+        if hasattr(X, "tocsc") and not isinstance(X, np.ndarray):
+            # scipy sparse: bin column-at-a-time without densifying the
+            # full matrix (same path training binning uses — Criteo-
+            # scale sparse predict must not materialize n x F floats)
+            Xc = X.tocsc()
+            n_rows = Xc.shape[0]
+            if Xc.shape[1] != ds.num_total_features:
+                log.fatal(
+                    f"The number of features in data ({Xc.shape[1]}) is "
+                    f"not the same as it was in training data "
+                    f"({ds.num_total_features})")
+
+            def _col(f):
+                colv = np.zeros(n_rows, np.float64)
+                sl = slice(Xc.indptr[f], Xc.indptr[f + 1])
+                colv[Xc.indices[sl]] = Xc.data[sl]
+                return colv
+        else:
+            X = Dataset._to_matrix(X)
+            n_rows = X.shape[0]
+            if X.shape[1] != ds.num_total_features:
+                log.fatal(
+                    f"The number of features in data ({X.shape[1]}) is "
+                    f"not the same as it was in training data "
+                    f"({ds.num_total_features})")
+
+            def _col(f):
+                return X[:, f]
+        cols = [ds.bin_mappers[f].values_to_bins(_col(f))
                 for f in ds.used_features]
         bins = (np.stack(cols, axis=1).astype(ds.binned.dtype)
-                if cols else np.zeros((X.shape[0], 0), ds.binned.dtype))
+                if cols else np.zeros((n_rows, 0), ds.binned.dtype))
         total_iters = len(self.models) // self.num_class
         if num_iteration <= 0:
             num_iteration = total_iters - start_iteration

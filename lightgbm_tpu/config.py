@@ -184,10 +184,16 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "lambdarank_truncation_level": _P("int", 30, [], (1, None)),
     "lambdarank_norm": _P("bool", True),
     "label_gain": _P("float_list", []),
-    # unbiased LambdaRank (rank_objective.hpp lambdarank_unbiased):
-    # learn per-rank click-propensity corrections from pairwise costs
+    # Position debiasing (rank_objective.hpp position_bias_; UNVERIFIED —
+    # empty mount): the reference activates it automatically when the
+    # dataset carries a `position` field; the propensity exponent is
+    # 1/(1 + lambdarank_position_bias_regularization). We mirror that.
+    # `lambdarank_unbiased` is an EXTENSION: force debiasing keyed on
+    # score rank when no explicit position field exists.
     "lambdarank_unbiased": _P("bool", False),
-    "lambdarank_bias_p_norm": _P("float", 0.5, [], (0.0, None)),
+    # -1 = derive the propensity exponent as 1/(1+regularization)
+    # (reference semantics); >=0 overrides it directly (extension).
+    "lambdarank_bias_p_norm": _P("float", -1.0, [], (-1.0, None)),
     "lambdarank_position_bias_regularization": _P("float", 0.0, [],
                                                   (0.0, None)),
     # ---- Metric parameters -----------------------------------------------
@@ -258,6 +264,12 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # per-iteration finite checks on tree outputs/scores (the aux
     # NaN-guard subsystem; costs a host sync per iteration)
     "tpu_debug_checks": _P("bool", False),
+    # checkify-based ON-DEVICE validation (SURVEY.md §5 sanitizer
+    # analog): each iteration, a jitted jax.experimental.checkify pass
+    # validates scores and the objective's gradients/hessians
+    # (finite, hessians non-negative) and surfaces the FIRST failure
+    # with iteration context instead of silently training NaN trees
+    "tpu_debug": _P("bool", False),
     # when set, wrap training in a jax.profiler trace (view with
     # TensorBoard / xprof) — the §5 tracing subsystem; the reference's
     # analog is the global function timers + GPU_DEBUG timing
@@ -292,6 +304,69 @@ del _name, _t, _d, _al, _b
 
 _TRUE_STRINGS = {"true", "1", "t", "yes", "y", "+", "on"}
 _FALSE_STRINGS = {"false", "0", "f", "no", "n", "-", "off"}
+
+# Parameters accepted for upstream compatibility but NOT acted on:
+# setting a NON-DEFAULT value warns once per process (never silently
+# ignored — reference parity per config_auto.cpp is "every documented
+# param acts"; tests/test_param_audit.py asserts this table + source
+# references cover the whole _PARAMS table). name -> what's missing.
+UNIMPLEMENTED_PARAMS: Dict[str, str] = {
+    "forcedsplits_filename": "forced split structures are not applied",
+    "forcedbins_filename": "forced bin boundaries are not applied",
+    "cegb_penalty_feature_lazy":
+        "per-row feature-acquisition tracking; use "
+        "cegb_penalty_feature_coupled",
+    "parser_config_file": "custom text-parser plugins are not supported",
+}
+_WARNED_UNIMPLEMENTED: set = set()
+
+# Parameters whose upstream effect legitimately DISSOLVES on this
+# backend: they are implementation/performance hints whose correct
+# TPU/XLA behavior is "no action" — accepted silently (warning on every
+# config that sets n_jobs would be pure noise). name -> why it
+# dissolves. The audit test requires every _PARAMS entry to be either
+# consumed in source, warned-on (UNIMPLEMENTED_PARAMS), or listed here.
+DISSOLVED_PARAMS: Dict[str, str] = {
+    "num_threads": "no host thread pool; XLA owns device parallelism",
+    "force_col_wise": "histogram layout is fixed by the TPU kernel "
+                      "(feature-major bins_t + row-major bins)",
+    "force_row_wise": "same as force_col_wise",
+    "histogram_pool_size": "the histogram pool is a device array sized "
+                           "by num_leaves (tpu_hist_mode picks "
+                           "pool/rebuild); no LRU cache to bound",
+    "is_enable_sparse": "sparse inputs are binned column-wise natively; "
+                        "there is no dense/sparse bin representation "
+                        "switch",
+    "feature_pre_filter": "an upstream binning-time optimization "
+                          "(pre-dropping features that cannot satisfy "
+                          "min_data_in_leaf); the split search enforces "
+                          "min_data_in_leaf exactly",
+    "two_round": "an upstream memory-saving load strategy; binning "
+                 "already samples via bin_construct_sample_cnt",
+    "precise_float_parser": "numpy's float parser is already "
+                            "round-trip precise",
+    "pre_partition": "row sharding is derived from the mesh, not "
+                     "pre-partitioned input files",
+    "num_machines": "the host set comes from jax.distributed, not a "
+                    "machine count param",
+    "time_out": "socket timeouts have no analog; collectives are "
+                "compiled XLA ops",
+    "machine_list_filename": "host discovery via jax.distributed "
+                             "coordinator, not a machine list file",
+    "machines": "same as machine_list_filename",
+    "local_listen_port": "no sockets; ICI/DCN transport is managed by "
+                         "the runtime",
+    "gpu_platform_id": "GPU-only knob; this is the TPU backend",
+    "gpu_device_id": "GPU-only knob; this is the TPU backend",
+    "gpu_use_dp": "GPU-only knob (tpu_double_precision_hist is the "
+                  "analog here)",
+    "num_gpu": "GPU-only knob (mesh size is the analog)",
+    "deterministic": "runs are deterministic by construction (counter-"
+                     "based RNG keys, fixed reduction orders per "
+                     "backend)",
+    "save_binary": "CLI task=save_binary / Dataset.save_binary cover "
+                   "this; the load-time side effect flag is not needed",
+}
 
 _OBJECTIVE_ALIASES = {
     # objective-name aliases, per src/objective/objective_function.cpp
@@ -433,6 +508,16 @@ class Config:
             if int(m) not in (-1, 0, 1):
                 log.fatal("monotone_constraints must be -1, 0 or 1, "
                           f"got {m}")
+        mcm = str(self.monotone_constraints_method).lower()
+        if mcm not in ("basic", "intermediate", "advanced"):
+            log.fatal(f"Unknown monotone_constraints_method {mcm!r}")
+        if mcm == "advanced" and "monotone_advanced" \
+                not in _WARNED_UNIMPLEMENTED:
+            _WARNED_UNIMPLEMENTED.add("monotone_advanced")
+            log.warning("monotone_constraints_method=advanced falls "
+                        "back to the intermediate method (the advanced "
+                        "slack-redistribution refinement is not "
+                        "implemented)")
         dev = str(self.device_type).lower()
         # cpu/gpu/cuda requests run on the TPU/XLA backend here
         if dev in ("cpu", "gpu", "cuda"):
@@ -441,6 +526,14 @@ class Config:
         if self.is_unbalance and self.scale_pos_weight != 1.0:
             log.fatal("Cannot set is_unbalance and scale_pos_weight at the "
                       "same time")
+        for name, detail in UNIMPLEMENTED_PARAMS.items():
+            _t, default, _a, _b = _PARAMS[name]
+            val = getattr(self, name)
+            if (name in self.raw_params and val != default
+                    and name not in _WARNED_UNIMPLEMENTED):
+                _WARNED_UNIMPLEMENTED.add(name)
+                log.warning(f"{name} is accepted but not implemented "
+                            f"({detail}); the setting has no effect")
 
     # -- helpers used across the framework ---------------------------------
     @property

@@ -13,12 +13,21 @@ time (bundled features share a column with bin offsets) — see bundling.py.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from ..utils import log
 from .binning import BIN_TYPE_CATEGORICAL, BinMapper, find_bin_mappers
+
+
+def _host_mem_bytes():
+    """Total physical host RAM, or None when undeterminable."""
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError, AttributeError):
+        return None
 
 
 def _coerce_1d(a) -> np.ndarray:
@@ -110,6 +119,22 @@ class Dataset:
         UNVERIFIED — empty mount); the arrow path mirrors basic.py's
         pyarrow Table handling."""
         if hasattr(data, "toarray"):          # scipy sparse
+            dense_bytes = int(data.shape[0]) * int(data.shape[1]) * 8
+            budget = _host_mem_bytes()
+            note = ("Training, valid-set construction and predict all "
+                    "bin sparse input column-wise without densifying — "
+                    "pass the sparse matrix to those APIs directly, or "
+                    "chunk rows for paths that need raw values")
+            if budget is not None and dense_bytes > 0.9 * budget:
+                log.fatal(
+                    f"densifying sparse input of shape {data.shape} "
+                    f"would need {dense_bytes / 2**30:.1f} GiB — more "
+                    f"than 90% of host RAM. {note}")
+            elif budget is not None and dense_bytes > 0.25 * budget:
+                log.warning(
+                    f"densifying sparse input of shape {data.shape} "
+                    f"({dense_bytes / 2**30:.1f} GiB, > 25% of host "
+                    f"RAM). {note}")
             return np.asarray(data.toarray(), dtype=np.float64)
         if (type(data).__module__ or "").startswith("pyarrow") \
                 and hasattr(data, "column_names"):   # pyarrow.Table
@@ -174,14 +199,7 @@ class Dataset:
         else:
             X = self._to_matrix(self.data)
             self.num_data, self.num_total_features = X.shape
-        if self.metadata.label is not None \
-                and len(self.metadata.label) != self.num_data:
-            log.fatal(f"Length of label ({len(self.metadata.label)}) does "
-                      f"not match number of data ({self.num_data})")
-        if self.metadata.weight is not None \
-                and len(self.metadata.weight) != self.num_data:
-            log.fatal(f"Length of weight ({len(self.metadata.weight)}) "
-                      f"does not match number of data ({self.num_data})")
+        self._validate_metadata()
         names = self._resolve_feature_names(self.num_total_features)
         self.feature_names = names
         cat_idx = self._resolve_categorical(names)
@@ -219,6 +237,21 @@ class Dataset:
         max_num_bin = max((self.bin_mappers[f].num_bin
                            for f in self.used_features), default=2)
         dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+        # capacity guard: fail with a clear message BEFORE allocating a
+        # binned matrix that cannot fit host RAM (the reference streams
+        # via pipeline_reader/two_round; out-of-core ingestion is not
+        # implemented here — SURVEY.md §7.4)
+        est = (int(self.num_data) * max(len(self.used_features), 1)
+               * np.dtype(dtype).itemsize)
+        budget = _host_mem_bytes()
+        if budget is not None and est > 0.9 * budget:
+            log.fatal(
+                f"binned dataset ({self.num_data} rows x "
+                f"{len(self.used_features)} features) would need "
+                f"{est / 2**30:.1f} GiB — more than 90% of host RAM "
+                f"({budget / 2**30:.1f} GiB). Reduce rows/features, "
+                f"lower max_bin to fit uint8, or shard rows across "
+                f"hosts (parallel/multihost.py)")
         cols = []
         for f in self.used_features:
             if is_sparse:
@@ -285,6 +318,29 @@ class Dataset:
             self._pushed_meta["weight"].append(_coerce_1d(weight).ravel())
         return self
 
+    def _validate_metadata(self) -> None:
+        """Length-check every metadata field against num_data (the
+        reference validates all Metadata fields at construct;
+        metadata.cpp — UNVERIFIED)."""
+        n = self.num_data
+        md = self.metadata
+        for fname in ("label", "weight", "position"):
+            v = getattr(md, fname)
+            if v is not None and len(v) != n:
+                log.fatal(f"Length of {fname} ({len(v)}) does not "
+                          f"match number of data ({n})")
+        if md.init_score is not None:
+            m = len(np.asarray(md.init_score).ravel())
+            # num_data, or num_data * num_class for multiclass
+            if m != n and (n == 0 or m % n != 0):
+                log.fatal(f"Length of init_score ({m}) does not match "
+                          f"number of data ({n})")
+        if md.query_boundaries is not None \
+                and int(md.query_boundaries[-1]) != n:
+            log.fatal(f"Sum of query counts "
+                      f"({int(md.query_boundaries[-1])}) does not match "
+                      f"number of data ({n})")
+
     def _finish_pushed(self) -> bool:
         """Finalize streamed rows at construct time; True if handled
         fully (reference path: chunks are already binned)."""
@@ -300,12 +356,7 @@ class Dataset:
             ref = self.reference.construct()
             self.binned = np.concatenate(self._pushed, axis=0)
             self.num_data = len(self.binned)
-            for fname in ("label", "weight"):
-                v = getattr(self.metadata, fname)
-                if v is not None and len(v) != self.num_data:
-                    log.fatal(f"Length of {fname} ({len(v)}) does not "
-                              f"match number of pushed rows "
-                              f"({self.num_data})")
+            self._validate_metadata()
             self.num_total_features = ref.num_total_features
             self.bin_mappers = ref.bin_mappers
             self.used_features = ref.used_features

@@ -96,7 +96,9 @@ class HostModel:
             missing_types.append(mt)
 
         obj = config.objective
-        if obj == "binary":
+        if obj == "regression" and getattr(config, "reg_sqrt", False):
+            obj_str = "regression sqrt"        # reference token order
+        elif obj == "binary":
             obj_str = f"binary sigmoid:{config.sigmoid:g}"
         elif obj in ("multiclass", "multiclassova"):
             obj_str = f"{obj} num_class:{config.num_class}"
@@ -143,6 +145,24 @@ class HostModel:
                 pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0) -> np.ndarray:
         from .dataset import Dataset as _DS
+        if hasattr(data, "tocsr") and not isinstance(data, np.ndarray) \
+                and data.shape[0] > 0:
+            # scipy sparse: densify in bounded row chunks (linear
+            # leaves / SHAP need raw feature values, but never the whole
+            # matrix at once)
+            csr = data.tocsr()
+            chunk = 65536
+            outs = [self.predict(
+                        csr[i:i + chunk].toarray(),
+                        raw_score=raw_score,
+                        start_iteration=start_iteration,
+                        num_iteration=num_iteration,
+                        pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                        pred_early_stop=pred_early_stop,
+                        pred_early_stop_freq=pred_early_stop_freq,
+                        pred_early_stop_margin=pred_early_stop_margin)
+                    for i in range(0, csr.shape[0], chunk)]
+            return np.concatenate(outs, axis=0)
         X = _DS._to_matrix(data)
         n = X.shape[0]
         total_iters = len(self.trees) // max(self.num_tree_per_iteration, 1)
@@ -210,6 +230,9 @@ class HostModel:
             return np.exp(raw[:, 0])
         if obj in ("cross_entropy", "xentropy"):
             return 1.0 / (1.0 + np.exp(-raw[:, 0]))
+        if obj == "regression" and "sqrt" in self.objective_str.split(" "):
+            r = raw[:, 0]
+            return np.sign(r) * r * r
         return raw[:, 0] if raw.shape[1] == 1 else raw
 
     def _predict_contrib(self, X, trees, K):
@@ -299,7 +322,8 @@ def _tree_to_string(t: Tree, missing_type: Optional[np.ndarray]) -> str:
     return "\n".join(lines) + "\n"
 
 
-def save_model_string(model: HostModel) -> str:
+def save_model_string(model: HostModel,
+                      importance_type: str = "split") -> str:
     tree_strs = []
     for i, t in enumerate(model.trees):
         mt = (model.missing_types[i]
@@ -321,17 +345,20 @@ def save_model_string(model: HostModel) -> str:
     ]
     out = "\n".join(header) + "\n" + "".join(tree_strs)
     out += "end of trees\n\n"
-    # feature importances (split counts), sorted desc like the reference
-    imp: Dict[str, int] = {}
+    # feature importances, sorted desc like the reference; split counts
+    # by default, total gain under saved_feature_importance_type=1
+    use_gain = importance_type in ("gain", 1, "1")
+    imp: Dict[str, float] = {}
     for t in model.trees:
-        for f in t.split_feature[:t.num_nodes]:
-            name = (model.feature_names[int(f)]
-                    if int(f) < len(model.feature_names)
-                    else f"Column_{int(f)}")
-            imp[name] = imp.get(name, 0) + 1
+        for j in range(t.num_nodes):
+            f = int(t.split_feature[j])
+            name = (model.feature_names[f]
+                    if f < len(model.feature_names) else f"Column_{f}")
+            w = float(t.split_gain[j]) if use_gain else 1
+            imp[name] = imp.get(name, 0) + w
     out += "feature_importances:\n"
     for name, cnt in sorted(imp.items(), key=lambda kv: -kv[1]):
-        out += f"{name}={cnt}\n"
+        out += f"{name}={cnt:g}\n" if use_gain else f"{name}={cnt}\n"
     out += "\nparameters:\n"
     for k, v in model.params.items():
         out += f"[{k}: {v}]\n"

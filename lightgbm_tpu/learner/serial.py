@@ -39,7 +39,8 @@ import jax.numpy as jnp
 from ..ops.pallas_histogram import (multi_leaf_histogram,
                                     multi_leaf_histogram_xla)
 from ..ops.split import (NEG_INF, SplitConfig, calc_leaf_output,
-                         elect_best, find_best_split, per_feature_gains)
+                         elect_best, find_best_split, per_feature_gains,
+                         smooth_output)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,9 +80,16 @@ class GrowConfig:
     # this axis; split search local, winner elected, partition via
     # ownership-psum (feature_parallel_tree_learner.cpp)
     feature_axis: str = ""
-    # constraints (monotone_constraints.hpp basic mode; ColSampler
-    # interaction constraints): zero-cost when False
+    # constraints (monotone_constraints.hpp; ColSampler interaction
+    # constraints): zero-cost when False. monotone_intermediate uses
+    # the realized child outputs as the children's bounds
+    # (IntermediateLeafConstraints) instead of basic's midpoint —
+    # WITHOUT the reference's retroactive ancestor updates (documented
+    # divergence); monotone_penalty discounts constrained-feature
+    # splits near the root
     has_monotone: bool = False
+    monotone_intermediate: bool = False
+    monotone_penalty: float = 0.0
     has_interaction: bool = False
     # EFB (dataset_loader.cpp FastFeatureBundling): bins is the bundled
     # PHYSICAL matrix; histograms are expanded to logical features via
@@ -98,6 +106,16 @@ class GrowConfig:
     has_cegb: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
+    # path smoothing (feature_histogram.hpp USE_SMOOTHING): children
+    # shrink toward the parent leaf's stored output by n/(n+alpha)
+    path_smooth: float = 0.0
+    # extra_trees (extremely randomized trees): one random numerical
+    # threshold per feature per node, drawn from node_key + extra_seed
+    extra_trees: bool = False
+    extra_seed: int = 6
+    # feature_contri per-feature gain multipliers (the `contri` array
+    # argument of grow_tree)
+    has_contri: bool = False
     # categorical split search (zero-cost when has_categorical=False);
     # cat_positions: static categorical indices for the sliced fast
     # path (empty under scatter/feature-parallel whose search space is
@@ -130,9 +148,13 @@ class GrowConfig:
             max_cat_to_onehot=self.max_cat_to_onehot,
             min_data_per_group=self.min_data_per_group,
             has_monotone=self.has_monotone,
+            monotone_penalty=self.monotone_penalty,
             has_cegb=self.has_cegb,
             cegb_tradeoff=self.cegb_tradeoff,
-            cegb_penalty_split=self.cegb_penalty_split)
+            cegb_penalty_split=self.cegb_penalty_split,
+            path_smooth=self.path_smooth,
+            extra_trees=self.extra_trees,
+            has_contri=self.has_contri)
 
 
 class GrowState(NamedTuple):
@@ -175,6 +197,13 @@ class GrowState(NamedTuple):
     leaf_lower: jnp.ndarray
     leaf_upper: jnp.ndarray
     leaf_used: jnp.ndarray
+    # intermediate monotone mode: [L, L+1] membership of each leaf in
+    # each node's left/right subtree ([1, 1] placeholder otherwise) —
+    # bounds are recomputed per round from CURRENT leaf outputs via
+    # masked min/max over these, the TPU-native replacement for
+    # IntermediateLeafConstraints' recursive constraint walks
+    mono_left: jnp.ndarray
+    mono_right: jnp.ndarray
 
 
 def _masked_gains(gain, leaf_depth, num_leaves, max_depth):
@@ -198,6 +227,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               chan_scale: jax.Array = None,
               node_key: jax.Array = None,
               cegb_pen: jax.Array = None,
+              contri: jax.Array = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -273,6 +303,12 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         # cap at 2048.
         import math
         r_cap = 4096 if bins_t.shape[0] * B <= 8192 else 2048
+        if bins_t.shape[0] <= 5 and B > 128:
+            # measured on v5e (round 3): at F<=4, B=256 Mosaic's stack
+            # allocation for the streamed one-hot blows scoped VMEM
+            # (28.7M > 16M) at R=4096; F=6 is fine. Narrow-F shapes are
+            # cheap anyway — halve the row block for safety margin.
+            r_cap = min(r_cap, 2048)
         pr = math.gcd(cfg.rows_per_block, r_cap)
 
         def hist_multi(leaf_id, small_ids):
@@ -295,6 +331,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         groups = None
     if not cfg.has_bundles:
         bundle = None
+    if not cfg.has_contri:
+        contri = None
     F_meta = feat_num_bin.shape[0]      # GLOBAL (logical) feature count
     if bundle is not None:
         assert not (mode_scatter or mode_feature), \
@@ -327,11 +365,13 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 if mono is not None else None)
         cp_s = (jax.lax.dynamic_slice_in_dim(cegb_pen, off, F_s)
                 if cegb_pen is not None else None)
+        ct_s = (jax.lax.dynamic_slice_in_dim(contri, off, F_s)
+                if contri is not None else None)
     else:
         off = jnp.zeros((), i32)
-        nb_s, hn_s, al_s, ic_s, mn_s, cp_s = (feat_num_bin, feat_has_nan,
-                                              allowed_feature, is_cat,
-                                              mono, cegb_pen)
+        nb_s, hn_s, al_s, ic_s, mn_s, cp_s, ct_s = (
+            feat_num_bin, feat_has_nan, allowed_feature, is_cat,
+            mono, cegb_pen, contri)
 
     def bynode_mask(allow2, round_tag):
         """Exact-k per-child column sampling
@@ -354,11 +394,24 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                                   axis=1)
         return allow2 & (u <= kth)
 
-    def search_best(hists, sums, lowers=None, uppers=None, allows=None):
+    def extra_uniforms(C, round_tag):
+        """Per-(child, feature) uniforms for extra_trees' one random
+        threshold per node — GLOBAL feature width, drawn from a common
+        key so every device slices a consistent random field."""
+        if not cfg.extra_trees or node_key is None:
+            return None
+        kk = jax.random.fold_in(
+            jax.random.fold_in(node_key, 0xE77A + cfg.extra_seed),
+            round_tag)
+        return jax.random.uniform(kk, (C, F_meta))
+
+    def search_best(hists, sums, lowers=None, uppers=None, allows=None,
+                    parent_outs=None, round_tag=0, depths=None):
         """Best split per child: ``hists [C, F_h, B, 3]`` (mode-reduced),
         ``sums [C, 3]`` global leaf totals, optional per-child monotone
-        output bounds (``[C]``) and interaction-constrained allowed
-        masks (``[C, F_meta]``, GLOBAL width). Returns per-child best
+        output bounds (``[C]``), interaction-constrained allowed
+        masks (``[C, F_meta]``, GLOBAL width), and per-child parent
+        outputs (``[C]``; path smoothing). Returns per-child best
         dict with GLOBAL feature indices, identical on every device."""
         C = hists.shape[0]
         if lowers is None:
@@ -366,6 +419,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             uppers = jnp.full(C, jnp.inf, jnp.float32)
         allows_g = (jnp.broadcast_to(allowed_feature, (C, F_meta))
                     if allows is None else allows)
+        eu = extra_uniforms(C, round_tag)                   # [C, F_meta]
         if mode_voting:
             # PV-Tree (voting_parallel_tree_learner.cpp): vote with
             # LOCAL histograms + local totals, elect global top-2k by
@@ -373,11 +427,15 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             local_sums = jnp.sum(hists[:, 0], axis=1)        # [C, 3]
             if bundle is not None:
                 hists = expand_hist(hists, local_sums)
-            pf = jax.vmap(lambda h, s, al, lo, hi: per_feature_gains(
-                h, s, feat_num_bin, feat_has_nan, al, scfg, is_cat,
-                mono=mono, out_lower=lo, out_upper=hi,
-                cegb_pen=cegb_pen))(
-                hists, local_sums, allows_g, lowers, uppers)  # [C, F]
+            pf = jax.vmap(lambda h, s, al, lo, hi, po, eu_, dp:
+                          per_feature_gains(
+                              h, s, feat_num_bin, feat_has_nan, al, scfg,
+                              is_cat, mono=mono, out_lower=lo,
+                              out_upper=hi, cegb_pen=cegb_pen,
+                              parent_out=po, extra_u=eu_,
+                              contri=contri, depth=dp))(
+                hists, local_sums, allows_g, lowers, uppers,
+                parent_outs, eu, depths)                     # [C, F]
             k_ = min(cfg.top_k, F_meta)
             vk = min(2 * cfg.top_k, F_meta)
             _, top_local = jax.lax.top_k(pf, k_)             # [C, k]
@@ -393,14 +451,19 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             ic_e = is_cat[elected] if is_cat is not None else None
             mn_e = mono[elected] if mono is not None else None
             cp_e = cegb_pen[elected] if cegb_pen is not None else None
+            ct_e = contri[elected] if contri is not None else None
+            eu_e = (jnp.take_along_axis(eu, elected, axis=1)
+                    if eu is not None else None)
             scfg_e = dataclasses.replace(scfg, cat_positions=())
             best = jax.vmap(
-                lambda h, s, nb, hn, al, ic, mn, cp, lo, hi:
+                lambda h, s, nb, hn, al, ic, mn, cp, lo, hi, po, eu_,
+                ct, dp:
                 find_best_split(
                     h, s, nb, hn, al, scfg_e, is_cat=ic, mono=mn,
-                    out_lower=lo, out_upper=hi, cegb_pen=cp))(
+                    out_lower=lo, out_upper=hi, cegb_pen=cp,
+                    parent_out=po, extra_u=eu_, contri=ct, depth=dp))(
                 hist_e, sums, nb_e, hn_e, al_e, ic_e, mn_e, cp_e,
-                lowers, uppers)
+                lowers, uppers, parent_outs, eu_e, ct_e, depths)
             best["feature"] = jnp.take_along_axis(
                 elected, best["feature"][:, None], axis=1)[:, 0]
             return best
@@ -409,10 +472,17 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         allows_s = (jax.lax.dynamic_slice_in_dim(allows_g, off, F_s,
                                                  axis=1)
                     if (mode_scatter or mode_feature) else allows_g)
-        best = jax.vmap(lambda h, s, al, lo, hi: find_best_split(
-            h, s, nb_s, hn_s, al, scfg, is_cat=ic_s, mono=mn_s,
-            out_lower=lo, out_upper=hi, cegb_pen=cp_s))(
-            hists, sums, allows_s, lowers, uppers)
+        eu_s = (jax.lax.dynamic_slice_in_dim(eu, off, F_s, axis=1)
+                if eu is not None and (mode_scatter or mode_feature)
+                else eu)
+        best = jax.vmap(lambda h, s, al, lo, hi, po, eu_, dp:
+                        find_best_split(
+                            h, s, nb_s, hn_s, al, scfg, is_cat=ic_s,
+                            mono=mn_s, out_lower=lo, out_upper=hi,
+                            cegb_pen=cp_s, parent_out=po, extra_u=eu_,
+                            contri=ct_s, depth=dp))(
+            hists, sums, allows_s, lowers, uppers, parent_outs, eu_s,
+            depths)
         best["feature"] = best["feature"] + off
         if mode_scatter:
             # SyncUpGlobalBestSplit across feature owners
@@ -424,6 +494,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     def leaf_out(sums):
         return calc_leaf_output(sums[..., 0], sums[..., 1], cfg.lambda_l1,
                                 cfg.lambda_l2, cfg.max_delta_step)
+
+    use_mono_inter = cfg.has_monotone and cfg.monotone_intermediate
 
     # ---- root ----------------------------------------------------------
     leaf_id0 = jnp.zeros(n_rows, dtype=i32)
@@ -446,9 +518,14 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         base = (root_allows if root_allows is not None
                 else jnp.broadcast_to(allowed_feature, (1, F_meta)))
         root_allows = bynode_mask(base, L + 7)
+    root_parent_out = (leaf_out(root_sums)[None]
+                       if cfg.path_smooth > 0.0 else None)
     root_best = jax.tree.map(
         lambda a: a[0], search_best(
-            root_hist[None], root_sums[None], allows=root_allows))
+            root_hist[None], root_sums[None], allows=root_allows,
+            parent_outs=root_parent_out, round_tag=L + 7,
+            depths=(jnp.zeros(1, i32)
+                    if cfg.monotone_penalty > 0.0 else None)))
 
     def set0(arr, value):
         return arr.at[0].set(value)
@@ -500,6 +577,10 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         leaf_upper=jnp.full(L + 1, jnp.inf, jnp.float32),
         leaf_used=jnp.zeros(
             (L + 1, F_meta if cfg.has_interaction else 1), jnp.bool_),
+        mono_left=jnp.zeros(
+            (L, L + 1) if use_mono_inter else (1, 1), jnp.bool_),
+        mono_right=jnp.zeros(
+            (L, L + 1) if use_mono_inter else (1, 1), jnp.bool_),
     )
 
     node_trash = L - 1  # real nodes occupy 0..L-2
@@ -681,21 +762,89 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             lvals = jnp.where(cat_split, leaf_out_cat(lsums), lvals)
             rvals = jnp.where(cat_split, leaf_out_cat(rsums), rvals)
 
-        # ---- constraint propagation (BasicLeafConstraints::Update) -----
+        if cfg.path_smooth > 0.0:
+            # children shrink toward the SPLIT leaf's stored output
+            # (feature_histogram.hpp passes tree->LeafOutput(leaf) as
+            # parent_output); smoothing applies before constraint clips
+            pvals = s.leaf_value[tl_safe]
+            lvals = smooth_output(lvals, lsums[:, 2], pvals,
+                                  cfg.path_smooth)
+            rvals = smooth_output(rvals, rsums[:, 2], pvals,
+                                  cfg.path_smooth)
+
+        # ---- constraint propagation (monotone_constraints.hpp) ---------
         if cfg.has_monotone:
             m_k = mono[s.best_feature[tl_safe]].astype(jnp.float32)
-            plo = s.leaf_lower[tl_safe]
-            phi = s.leaf_upper[tl_safe]
+            if use_mono_inter:
+                # intermediate mode: bounds recomputed each round from
+                # the CURRENT leaf outputs of every constrained node's
+                # opposing subtree (IntermediateLeafConstraints'
+                # semantics) — masked min/max over the [L, L+1]
+                # membership matrices instead of recursive tree walks.
+                # Cached best splits from earlier rounds may predate a
+                # bound tightening; the clip below re-applies the
+                # CURRENT bound at split time, keeping every realized
+                # output sound by induction.
+                leaf_ax = jnp.arange(L + 1, dtype=i32)
+                node_ok = jnp.arange(L, dtype=i32) < s.split_idx
+                node_m = jnp.where(node_ok,
+                                   mono[s.split_feature], 0)     # [L]
+                act = leaf_ax < s.num_leaves                     # [L+1]
+                vals_c = s.leaf_value
+                big = jnp.float32(jnp.inf)
+                inf_r = jnp.where(s.mono_right & act[None, :],
+                                  vals_c[None, :], big)
+                inf_l = jnp.where(s.mono_left & act[None, :],
+                                  vals_c[None, :], big)
+                rmin = jnp.min(inf_r, axis=1)                    # [L]
+                lmin = jnp.min(inf_l, axis=1)
+                rmax = jnp.max(jnp.where(s.mono_right & act[None, :],
+                                         vals_c[None, :], -big), axis=1)
+                lmax = jnp.max(jnp.where(s.mono_left & act[None, :],
+                                         vals_c[None, :], -big), axis=1)
+                in_l = s.mono_left[:, tl_safe]                   # [L, Kb]
+                in_r = s.mono_right[:, tl_safe]
+                # batch race guard: when THIS round splits leaves on
+                # BOTH sides of a constrained node, each side would use
+                # the other's pre-round value and their children could
+                # cross; those nodes fall back to a shared midpoint cut
+                # (sound for concurrent updates), everything else keeps
+                # the looser one-sided bound
+                both = (jnp.any(in_l & valid[None, :], axis=1)
+                        & jnp.any(in_r & valid[None, :], axis=1))  # [L]
+                c_inc = jnp.where(both, 0.5 * (lmax + rmin), 0.0)
+                c_dec = jnp.where(both, 0.5 * (lmin + rmax), 0.0)
+                nup_l = jnp.where(both, c_inc, rmin)  # inc, leaf on left
+                nlo_r = jnp.where(both, c_inc, lmax)  # inc, leaf on right
+                nup_r = jnp.where(both, c_dec, lmin)  # dec, leaf on right
+                nlo_l = jnp.where(both, c_dec, rmax)  # dec, leaf on left
+                pos = (node_m > 0)[:, None]
+                neg = (node_m < 0)[:, None]
+                phi = jnp.min(jnp.where(
+                    pos & in_l, nup_l[:, None],
+                    jnp.where(neg & in_r, nup_r[:, None], big)), axis=0)
+                plo = jnp.max(jnp.where(
+                    pos & in_r, nlo_r[:, None],
+                    jnp.where(neg & in_l, nlo_l[:, None], -big)), axis=0)
+            else:
+                plo = s.leaf_lower[tl_safe]
+                phi = s.leaf_upper[tl_safe]
             lvals = jnp.clip(lvals, plo, phi)
             rvals = jnp.clip(rvals, plo, phi)
-            # basic mode: the mid-point of the realized outputs becomes
-            # the shared bound of the two children, so any LATER split
-            # below either child cannot cross it
-            mid = 0.5 * (lvals + rvals)
-            lo_l = jnp.where(m_k < 0, jnp.maximum(plo, mid), plo)
-            hi_l = jnp.where(m_k > 0, jnp.minimum(phi, mid), phi)
-            lo_r = jnp.where(m_k > 0, jnp.maximum(plo, mid), plo)
-            hi_r = jnp.where(m_k < 0, jnp.minimum(phi, mid), phi)
+            if use_mono_inter:
+                # children are bounded by the SIBLING's realized output
+                # (looser than basic's midpoint; later tightenings are
+                # picked up by the per-round recompute above)
+                bound_l, bound_r = rvals, lvals
+            else:
+                # basic mode: the mid-point of the realized outputs
+                # becomes the shared bound of the two children, so any
+                # LATER split below either child cannot cross it
+                bound_l = bound_r = 0.5 * (lvals + rvals)
+            lo_l = jnp.where(m_k < 0, jnp.maximum(plo, bound_l), plo)
+            hi_l = jnp.where(m_k > 0, jnp.minimum(phi, bound_l), phi)
+            lo_r = jnp.where(m_k > 0, jnp.maximum(plo, bound_r), plo)
+            hi_r = jnp.where(m_k < 0, jnp.minimum(phi, bound_r), phi)
             child_lower = jnp.concatenate([lo_l, lo_r])
             child_upper = jnp.concatenate([hi_l, hi_r])
         else:
@@ -719,11 +868,30 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                                           (2 * Kb, F_meta)))
             child_allow = bynode_mask(base, s.split_idx)
 
+        # ---- intermediate-mode membership updates ----------------------
+        if use_mono_inter:
+            # children inherit the split leaf's subtree memberships
+            # (column copy), then register under the new node
+            ml = s.mono_left.at[:, new_ids].set(s.mono_left[:, tl_safe])
+            mr = s.mono_right.at[:, new_ids].set(
+                s.mono_right[:, tl_safe])
+            ml = ml.at[node_ids, tl_safe].set(True)
+            mr = mr.at[node_ids, new_ids].set(True)
+        else:
+            ml, mr = s.mono_left, s.mono_right
+
         # ---- best splits for all 2*Kb children -------------------------
         child_hists = jnp.concatenate([left_hist, right_hist])
         child_sums = jnp.concatenate([lsums, rsums])
         bests = search_best(child_hists, child_sums,
-                            child_lower, child_upper, child_allow)
+                            child_lower, child_upper, child_allow,
+                            parent_outs=(jnp.concatenate([lvals, rvals])
+                                         if cfg.path_smooth > 0.0
+                                         else None),
+                            round_tag=s.split_idx,
+                            depths=(jnp.concatenate([depth2, depth2])
+                                    if cfg.monotone_penalty > 0.0
+                                    else None))
         ids2 = jnp.concatenate([tl_safe, new_ids])
 
         # ---- tree wiring -----------------------------------------------
@@ -775,7 +943,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             right_child=rc,
             split_gain=s.split_gain.at[node_ids].set(top_gain),
             internal_value=s.internal_value.at[node_ids].set(
-                leaf_out(psums)),
+                s.leaf_value[tl_safe] if cfg.path_smooth > 0.0
+                else leaf_out(psums)),
             internal_count=s.internal_count.at[node_ids].set(psums[:, 2]),
             leaf_value=s.leaf_value.at[ids2].set(
                 jnp.concatenate([lvals, rvals])),
@@ -792,6 +961,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                         if cfg.has_monotone else s.leaf_upper),
             leaf_used=(s.leaf_used.at[ids2].set(child_used)
                        if cfg.has_interaction else s.leaf_used),
+            mono_left=ml,
+            mono_right=mr,
         )
         next_gains = _masked_gains(new.best_gain, new.leaf_depth,
                                    new.num_leaves, cfg.max_depth)

@@ -58,6 +58,25 @@ class Objective:
             return grad, hess
         return grad * weight, hess * weight
 
+    # -- multi-host BoostFromAverage sync (the reference's
+    # Network::GlobalSyncUpByMean; SURVEY.md §2.3) ----------------------
+    def init_mean_stats(self, label, weight):
+        """``(weighted_sum, weight_total)`` such that
+        ``init_from_mean(weighted_sum / weight_total)`` reproduces
+        ``init_score`` — the syncable decomposition for multi-host
+        boost_from_average. None when the init score is not a mean
+        statistic (the median/percentile family)."""
+        return None
+
+    def init_from_mean(self, mean: float) -> float:
+        raise NotImplementedError
+
+    @staticmethod
+    def _mean_stats_of(v: np.ndarray, weight) -> Tuple[float, float]:
+        if weight is None:
+            return float(np.sum(v)), float(len(v))
+        return float(np.sum(v * weight)), float(np.sum(weight))
+
     @staticmethod
     def _wavg(v: np.ndarray, weight: Optional[np.ndarray]) -> float:
         if weight is None:
@@ -71,15 +90,39 @@ class Objective:
 class RegressionL2(Objective):
     name = "regression"
 
+    def __init__(self, config):
+        super().__init__(config)
+        # reg_sqrt (regression_objective.hpp sqrt mode): fit
+        # sign(y)*sqrt(|y|) instead of y; predictions convert back as
+        # sign(s)*s^2
+        self.reg_sqrt = bool(getattr(config, "reg_sqrt", False))
+
     def init_score(self, label, weight):
         if not self.config.boost_from_average:
             return 0.0
+        if self.reg_sqrt:
+            label = np.sign(label) * np.sqrt(np.abs(label))
         return self._wavg(label, weight)
 
     def get_gradients(self, score, label, weight):
+        if self.reg_sqrt:
+            label = jnp.sign(label) * jnp.sqrt(jnp.abs(label))
         grad = score - label
         hess = jnp.ones_like(score)
         return self._apply_weight(grad, hess, weight)
+
+    def convert_output(self, score):
+        if self.reg_sqrt:
+            return jnp.sign(score) * score * score
+        return score
+
+    def init_mean_stats(self, label, weight):
+        if self.reg_sqrt:
+            label = np.sign(label) * np.sqrt(np.abs(label))
+        return self._mean_stats_of(label, weight)
+
+    def init_from_mean(self, mean):
+        return float(mean)
 
 
 class RegressionL1(Objective):
@@ -140,6 +183,12 @@ class Poisson(Objective):
     def convert_output(self, score):
         return jnp.exp(score)
 
+    def init_mean_stats(self, label, weight):
+        return self._mean_stats_of(np.asarray(label, np.float64), weight)
+
+    def init_from_mean(self, mean):
+        return float(np.log(max(mean, 1e-9)))
+
 
 class Quantile(Objective):
     name = "quantile"
@@ -199,6 +248,12 @@ class Gamma(Objective):
     def convert_output(self, score):
         return jnp.exp(score)
 
+    def init_mean_stats(self, label, weight):
+        return self._mean_stats_of(np.asarray(label, np.float64), weight)
+
+    def init_from_mean(self, mean):
+        return float(np.log(max(mean, 1e-9)))
+
 
 class Tweedie(Objective):
     name = "tweedie"
@@ -218,6 +273,12 @@ class Tweedie(Objective):
 
     def convert_output(self, score):
         return jnp.exp(score)
+
+    def init_mean_stats(self, label, weight):
+        return self._mean_stats_of(np.asarray(label, np.float64), weight)
+
+    def init_from_mean(self, mean):
+        return float(np.log(max(mean, 1e-9)))
 
 
 # ---------------------------------------------------------------------------
@@ -268,6 +329,14 @@ class Binary(Objective):
 
     def convert_output(self, score):
         return jax.nn.sigmoid(self.sigmoid * score)
+
+    def init_mean_stats(self, label, weight):
+        return self._mean_stats_of((label > 0).astype(np.float64),
+                                   weight)
+
+    def init_from_mean(self, mean):
+        pavg = min(max(float(mean), 1e-15), 1.0 - 1e-15)
+        return float(np.log(pavg / (1.0 - pavg)) / self.sigmoid)
 
 
 # ---------------------------------------------------------------------------

@@ -69,6 +69,11 @@ class _RankingBase(Objective):
             padded = np.where(idx >= 0, pos[np.clip(idx, 0, None)], 0)
             self._qpos = jnp.asarray(padded.astype(np.int32))
             self._n_positions = int(pos.max()) + 1
+            self._positions_set()
+
+    def _positions_set(self) -> None:
+        """Hook: a `position` field was attached (overridden by
+        LambdaRank to auto-enable debiasing, reference behavior)."""
 
     def _gather_queries(self, arr):
         safe = jnp.maximum(self._qidx, 0)
@@ -83,19 +88,40 @@ class LambdaRank(_RankingBase):
         self.sigmoid = config.sigmoid
         self.truncation = config.lambdarank_truncation_level
         self.norm = config.lambdarank_norm
-        # unbiased LambdaRank (rank_objective.hpp `lambdarank_unbiased`,
+        # Position debiasing (rank_objective.hpp position_bias_,
         # UNVERIFIED — empty mount; formulation follows Unbiased
-        # LambdaMART, Hu et al. 2019): per-RANK propensity corrections
-        # t+ (clicked/high side) and t- (unclicked/low side), estimated
-        # each iteration from the accumulated pairwise logistic costs and
-        # applied as 1/(t_i+ * t_j-) pair weights. State threads through
-        # the boosting step (has_pos_state protocol in boosting/gbdt.py).
+        # LambdaMART, Hu et al. 2019): per-position propensity
+        # corrections t+ (clicked/high side) and t- (unclicked/low
+        # side), estimated each iteration from the accumulated pairwise
+        # logistic costs and applied as 1/(t_i+ * t_j-) pair weights.
+        # The reference enables this automatically when the dataset has
+        # a `position` field (see _positions_set); `lambdarank_unbiased`
+        # additionally forces it keyed on score rank (extension). State
+        # threads through the boosting step (has_pos_state protocol in
+        # boosting/gbdt.py).
         self.unbiased = bool(getattr(config, "lambdarank_unbiased", False))
         self.has_pos_state = self.unbiased
-        self.bias_p_norm = float(getattr(config, "lambdarank_bias_p_norm",
-                                         0.5))
         self.bias_reg = float(getattr(
             config, "lambdarank_position_bias_regularization", 0.0))
+        # propensity exponent: reference uses 1/(1+regularization);
+        # lambdarank_bias_p_norm >= 0 overrides it directly (extension)
+        _p = float(getattr(config, "lambdarank_bias_p_norm", -1.0))
+        if _p < 0.0 and _p != -1.0:
+            log.fatal("lambdarank_bias_p_norm must be -1 (derive from "
+                      "lambdarank_position_bias_regularization) or >= 0, "
+                      f"got {_p}")
+        self.bias_p_norm = _p if _p >= 0.0 else 1.0 / (1.0 + self.bias_reg)
+
+    def _positions_set(self) -> None:
+        # reference behavior: an explicit position field activates
+        # debiasing without any flag
+        if not self.unbiased:
+            log.info("position field detected: enabling LambdaRank "
+                     "position debiasing (set lambdarank_unbiased=false "
+                     "has no effect here; drop the position field to "
+                     "train without debiasing)")
+        self.unbiased = True
+        self.has_pos_state = True
 
     def init_pos_state(self):
         """Initial per-rank propensities: all ones ([2, S] — row 0 = t+
@@ -245,12 +271,13 @@ class LambdaRank(_RankingBase):
             hess = hess * weight
         if not unbiased:
             return grad, hess
-        # ---- propensity update: t[r] = (C[r] / C[0])^p, shrunk toward
-        # 1 by the regularization term (reference constants UNVERIFIED —
-        # empty mount; p_norm=0 makes this an exact no-op, pinned by
-        # tests/test_ranking_unbiased.py) --------------------------------
-        chi = jnp.sum(cost_hi, axis=0)                     # [M]
-        clo = jnp.sum(cost_lo, axis=0)
+        # ---- propensity update: t[r] = (C[r] / C[0])^p with
+        # p = 1/(1+lambdarank_position_bias_regularization) (reference
+        # UpdatePositionBiasFactors semantics, UNVERIFIED — empty mount;
+        # an explicit lambdarank_bias_p_norm=0 makes this an exact
+        # no-op, pinned by tests/test_ranking_unbiased.py) ---------------
+        chi = jnp.sum(cost_hi, axis=0)                     # [S]
+        clo = jnp.sum(cost_lo, axis=0)                     # [S]
 
         def propensity(c):
             # anchor on the first position that actually accumulated
@@ -260,7 +287,6 @@ class LambdaRank(_RankingBase):
             c0 = jnp.maximum(c[first], 1e-20)
             ratio = jnp.maximum(c / c0, 1e-6)
             t = ratio ** self.bias_p_norm
-            t = (t + self.bias_reg) / (1.0 + self.bias_reg)
             # ranks that saw no pairs keep their neutral propensity
             return jnp.where(c > 0, jnp.maximum(t, 1e-3), 1.0)
 

@@ -24,16 +24,23 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-jnp.inf)
+# a plain Python float (weak-typed -> f32 under jnp ops), NOT a device
+# array: materializing an array at import time would initialize the XLA
+# backend and break jax.distributed.initialize for multi-host users
+NEG_INF = float("-inf")
 
 
 def _cumsum_bins(hist_vals: jax.Array) -> jax.Array:
     """Inclusive cumsum over the bin axis of ``[F, B, C]`` as a
     triangular-matrix product. XLA lowers ``jnp.cumsum`` to a VPU
     reduce-window (~10 ms per 64-child round at B=256 on v5e); the same
-    O(F*B^2*C) MACs ride the MXU in microseconds. Counts stay exact:
-    they are integers < 2^24, and 0/1-weighted f32 sums of such values
-    are exact in any summation order at HIGHEST precision.
+    O(F*B^2*C) MACs ride the MXU in microseconds. Exactness holds for
+    the COUNT channel only: counts are integers < 2^24, and 0/1-weighted
+    f32 sums of such values are exact in any summation order at HIGHEST
+    precision. The f32 grad/hess channels are accumulated in a different
+    order than ``jnp.cumsum``, so their prefix sums can differ in ULPs
+    between the TPU matmul path and the CPU/wide-B path — enough to flip
+    near-tied split choices across backends.
 
     TPU-only: the matmul trades O(F*B*C) adds for O(F*B^2*C) MACs — a
     win only where the MXU makes MACs ~free. The CPU/XLA path (and the
@@ -88,6 +95,23 @@ class SplitConfig:
     has_cegb: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
+    # monotone_penalty (monotone_constraints.hpp
+    # ComputeMonotoneSplitGainPenalty): gains of splits on constrained
+    # features are scaled by a depth-dependent factor < 1, discouraging
+    # them near the root; needs the `depth` argument
+    monotone_penalty: float = 0.0
+    # path smoothing (feature_histogram.hpp CalculateSplittedLeafOutput
+    # USE_SMOOTHING): child outputs shrink toward the parent leaf's
+    # output by n/(n+path_smooth); gains evaluated at smoothed outputs
+    path_smooth: float = 0.0
+    # extremely randomized trees (feature_histogram.hpp USE_RAND_SEED):
+    # the numerical scan evaluates ONE random threshold per feature per
+    # node (categorical search is not randomized here — extension gap,
+    # documented)
+    extra_trees: bool = False
+    # feature_contri: per-feature split-gain multiplier (read from the
+    # `contri` array argument when True)
+    has_contri: bool = False
 
 
 def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
@@ -125,6 +149,26 @@ def leaf_gain_at_output(sum_g: jax.Array, sum_h: jax.Array, l1: float,
     return -(2.0 * t * output + (sum_h + l2) * output * output)
 
 
+def smooth_output(raw: jax.Array, count: jax.Array, parent_out,
+                  alpha: float) -> jax.Array:
+    """Path smoothing (feature_histogram.hpp USE_SMOOTHING):
+    ``raw * n/(n+alpha) + parent_out * alpha/(n+alpha)``."""
+    w = count / (count + alpha)
+    return raw * w + parent_out * (1.0 - w)
+
+
+def monotone_penalty_factor(depth, penalization: float) -> jax.Array:
+    """Gain multiplier for splits on monotone-constrained features
+    (monotone_constraints.hpp ComputeMonotoneSplitGainPenalty):
+    ~0 while depth + 1 <= penalization, then decays toward 1."""
+    eps = 1e-10
+    d = depth.astype(jnp.float32) if hasattr(depth, "astype") else float(depth)
+    f_small = 1.0 - penalization / (2.0 ** d) + eps        # pen <= 1
+    f_large = 1.0 - 2.0 ** (penalization - 1.0 - d) + eps  # pen > 1
+    f = jnp.where(jnp.asarray(penalization) <= 1.0, f_small, f_large)
+    return jnp.where(penalization >= d + 1.0, eps, f)
+
+
 def _pack_bitset(inset: jax.Array, n_words: int) -> jax.Array:
     """Pack a ``[B]`` bool left-set into ``[n_words]`` uint32 words."""
     b = inset.shape[0]
@@ -139,7 +183,7 @@ def _pack_bitset(inset: jax.Array, n_words: int) -> jax.Array:
 def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
                             is_cat, cfg: SplitConfig,
                             out_lower=None, out_upper=None,
-                            cegb_pen=None):
+                            cegb_pen=None, parent_out=None, contri=None):
     """Candidate categorical gains: ``(all_gain [F, 3, B], orders
     [F, 2, B], cum [F, 2, B, 3], valid_bin [F, B])`` — modes are
     (one-hot, sorted-asc, sorted-desc). With monotone bounds active,
@@ -152,10 +196,13 @@ def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
     l1, l2c = cfg.lambda_l1, cfg.lambda_l2 + cfg.cat_l2
     pg, ph, pc = parent_sums[0], parent_sums[1], parent_sums[2]
     bounded = cfg.has_monotone and out_lower is not None
-    if bounded:
-        p_out = jnp.clip(calc_leaf_output(pg, ph, l1, l2c,
-                                          cfg.max_delta_step),
-                         out_lower, out_upper)
+    smoothed = cfg.path_smooth > 0.0 and parent_out is not None
+    if bounded or smoothed:
+        p_out = calc_leaf_output(pg, ph, l1, l2c, cfg.max_delta_step)
+        if smoothed:
+            p_out = smooth_output(p_out, pc, parent_out, cfg.path_smooth)
+        if bounded:
+            p_out = jnp.clip(p_out, out_lower, out_upper)
         parent_gain = leaf_gain_at_output(pg, ph, l1, l2c, p_out)
     else:
         parent_gain = leaf_gain(pg, ph, l1, l2c)
@@ -167,13 +214,15 @@ def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
 
     def child_gain(lg, lh, lc):
         rg, rh, rc = pg - lg, ph - lh, pc - lc
-        if bounded:
-            lo = jnp.clip(calc_leaf_output(lg, lh, l1, l2c,
-                                           cfg.max_delta_step),
-                          out_lower, out_upper)
-            ro = jnp.clip(calc_leaf_output(rg, rh, l1, l2c,
-                                           cfg.max_delta_step),
-                          out_lower, out_upper)
+        if bounded or smoothed:
+            lo = calc_leaf_output(lg, lh, l1, l2c, cfg.max_delta_step)
+            ro = calc_leaf_output(rg, rh, l1, l2c, cfg.max_delta_step)
+            if smoothed:
+                lo = smooth_output(lo, lc, parent_out, cfg.path_smooth)
+                ro = smooth_output(ro, rc, parent_out, cfg.path_smooth)
+            if bounded:
+                lo = jnp.clip(lo, out_lower, out_upper)
+                ro = jnp.clip(ro, out_lower, out_upper)
             g = (leaf_gain_at_output(lg, lh, l1, l2c, lo)
                  + leaf_gain_at_output(rg, rh, l1, l2c, ro)
                  - parent_gain)
@@ -213,6 +262,9 @@ def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
 
     all_gain = jnp.concatenate(
         [gain_oh[:, None, :], gain_sorted], axis=1)           # [F, 3, B]
+    if cfg.has_contri and contri is not None:
+        all_gain = jnp.where(jnp.isfinite(all_gain),
+                             all_gain * contri[:, None, None], all_gain)
     if cfg.has_cegb:
         # penalize BEFORE the argmax so the per-feature selection sees
         # the discounted gains, mirroring the numerical path
@@ -229,7 +281,7 @@ def _categorical_candidates(hist, parent_sums, num_bin, allowed_feature,
 
 def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
                       cfg: SplitConfig, out_lower=None, out_upper=None,
-                      cegb_pen=None):
+                      cegb_pen=None, parent_out=None, contri=None):
     """Best categorical split (one-hot + sorted many-vs-many).
 
     Reference: ``FindBestThresholdCategoricalInner``
@@ -249,7 +301,8 @@ def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]
     all_gain, orders, cum, valid_bin = _categorical_candidates(
         hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
-        out_lower=out_lower, out_upper=out_upper, cegb_pen=cegb_pen)
+        out_lower=out_lower, out_upper=out_upper, cegb_pen=cegb_pen,
+        parent_out=parent_out, contri=contri)
     flat = all_gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -272,14 +325,23 @@ def _categorical_best(hist, parent_sums, num_bin, allowed_feature, is_cat,
 
 def _numerical_candidates(hist, parent_sums, num_bin, has_nan,
                           num_allowed, cfg: SplitConfig,
-                          mono=None, out_lower=None, out_upper=None):
+                          mono=None, out_lower=None, out_upper=None,
+                          parent_out=None, extra_u=None, contri=None,
+                          depth=None):
     """Numerical threshold-scan gains: ``(gain [F, B, 2],
     left [F, B, 2, 3])`` — dir 0: missing right, dir 1: missing left.
 
     With ``cfg.has_monotone``: ``mono [F]`` in {-1, 0, +1} and the
     leaf's inherited output range ``[out_lower, out_upper]`` (scalars);
     candidate outputs are clipped to the range, gains evaluated at the
-    clipped outputs, and direction-violating thresholds vetoed."""
+    clipped outputs, and direction-violating thresholds vetoed.
+    With ``cfg.path_smooth > 0``: candidate outputs shrink toward
+    ``parent_out`` (the leaf's stored output) before any clipping.
+    With ``cfg.extra_trees``: ``extra_u [F]`` uniforms pick ONE random
+    threshold per feature; all others are vetoed.
+    With ``cfg.has_contri``: valid gains scale by ``contri [F]``
+    (validity is checked on the unscaled gain, like the reference's
+    penalty-after-threshold-check order)."""
     f, b, _ = hist.shape
     bin_idx = jnp.arange(b, dtype=jnp.int32)[None, :]          # [1, B]
     nan_bin = (num_bin - 1)[:, None]                           # [F, 1]
@@ -298,32 +360,39 @@ def _numerical_candidates(hist, parent_sums, num_bin, has_nan,
     lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
     rg, rh, rc = right[..., 0], right[..., 1], right[..., 2]
 
-    parent_gain = leaf_gain(parent_sums[0], parent_sums[1],
-                            cfg.lambda_l1, cfg.lambda_l2)
-    if cfg.has_monotone and mono is not None:
+    use_mono = cfg.has_monotone and mono is not None
+    use_smooth = cfg.path_smooth > 0.0 and parent_out is not None
+    violates = None
+    if use_mono or use_smooth:
         l1, l2 = cfg.lambda_l1, cfg.lambda_l2
-        l_out = jnp.clip(calc_leaf_output(lg, lh, l1, l2,
-                                          cfg.max_delta_step),
-                         out_lower, out_upper)
-        r_out = jnp.clip(calc_leaf_output(rg, rh, l1, l2,
-                                          cfg.max_delta_step),
-                         out_lower, out_upper)
-        # the parent's gain must be evaluated at ITS clipped output too,
-        # or clipped leaves have every candidate gain deflated
-        p_out = jnp.clip(calc_leaf_output(parent_sums[0], parent_sums[1],
-                                          l1, l2, cfg.max_delta_step),
-                         out_lower, out_upper)
+        l_out = calc_leaf_output(lg, lh, l1, l2, cfg.max_delta_step)
+        r_out = calc_leaf_output(rg, rh, l1, l2, cfg.max_delta_step)
+        p_out = calc_leaf_output(parent_sums[0], parent_sums[1],
+                                 l1, l2, cfg.max_delta_step)
+        if use_smooth:
+            a = cfg.path_smooth
+            l_out = smooth_output(l_out, lc, parent_out, a)
+            r_out = smooth_output(r_out, rc, parent_out, a)
+            p_out = smooth_output(p_out, parent_sums[2], parent_out, a)
+        if use_mono:
+            # the parent's gain must be evaluated at ITS clipped output
+            # too, or clipped leaves have every candidate gain deflated
+            l_out = jnp.clip(l_out, out_lower, out_upper)
+            r_out = jnp.clip(r_out, out_lower, out_upper)
+            p_out = jnp.clip(p_out, out_lower, out_upper)
         parent_gain_c = leaf_gain_at_output(parent_sums[0],
                                             parent_sums[1], l1, l2, p_out)
         gain = (leaf_gain_at_output(lg, lh, l1, l2, l_out)
                 + leaf_gain_at_output(rg, rh, l1, l2, r_out)
                 - parent_gain_c)
-        # veto thresholds that violate the feature's direction:
-        # +1 (increasing): left (smaller values) must not exceed right
-        violates = (mono[:, None, None].astype(jnp.float32)
-                    * (l_out - r_out)) > 0
-        gain = jnp.where(violates, NEG_INF, gain)
+        if use_mono:
+            # veto thresholds that violate the feature's direction:
+            # +1 (increasing): left (smaller values) must not exceed right
+            violates = (mono[:, None, None].astype(jnp.float32)
+                        * (l_out - r_out)) > 0
     else:
+        parent_gain = leaf_gain(parent_sums[0], parent_sums[1],
+                                cfg.lambda_l1, cfg.lambda_l2)
         gain = (leaf_gain(lg, lh, cfg.lambda_l1, cfg.lambda_l2)
                 + leaf_gain(rg, rh, cfg.lambda_l1, cfg.lambda_l2)
                 - parent_gain)
@@ -339,6 +408,22 @@ def _numerical_candidates(hist, parent_sums, num_bin, has_nan,
              & (lh >= cfg.min_sum_hessian_in_leaf)
              & (rh >= cfg.min_sum_hessian_in_leaf)
              & (gain > cfg.min_gain_to_split))
+    if violates is not None:
+        valid = valid & ~violates
+    if cfg.extra_trees and extra_u is not None:
+        # one random threshold per feature (valid thresholds occupy
+        # bin_idx < num_bin - 1 regardless of the NaN bin)
+        t_extra = (extra_u * (num_bin - 1).astype(jnp.float32)
+                   ).astype(jnp.int32)                         # [F]
+        valid = valid & (bin_idx == t_extra[:, None])[:, :, None]
+    if cfg.has_contri and contri is not None:
+        gain = gain * contri[:, None, None]
+    if cfg.monotone_penalty > 0.0 and mono is not None \
+            and depth is not None:
+        # applied AFTER the min_gain validity check, like the
+        # reference's post-FindBestThreshold gain scaling
+        pf = monotone_penalty_factor(depth, cfg.monotone_penalty)
+        gain = jnp.where((mono != 0)[:, None, None], gain * pf, gain)
     return jnp.where(valid, gain, NEG_INF), left
 
 
@@ -347,7 +432,8 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
                       allowed_feature: jax.Array, cfg: SplitConfig,
                       is_cat: jax.Array = None, mono=None,
                       out_lower=None, out_upper=None,
-                      cegb_pen=None) -> jax.Array:
+                      cegb_pen=None, parent_out=None, extra_u=None,
+                      contri=None, depth=None) -> jax.Array:
     """Best achievable gain per feature (``[F]``) — the local VOTE metric
     of the voting-parallel learner (PV-Tree,
     voting_parallel_tree_learner.cpp: machines propose their top-k
@@ -358,7 +444,10 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
     gain, _ = _numerical_candidates(hist, parent_sums, num_bin, has_nan,
                                     num_allowed, cfg, mono=mono,
                                     out_lower=out_lower,
-                                    out_upper=out_upper)
+                                    out_upper=out_upper,
+                                    parent_out=parent_out,
+                                    extra_u=extra_u, contri=contri,
+                                    depth=depth)
     pf = jnp.max(gain, axis=(1, 2))                            # [F]
     if cfg.has_cegb:
         # vote on PENALIZED gains (the coupled term changes feature
@@ -375,7 +464,9 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
                 hist[ca], parent_sums, num_bin[ca], allowed_feature[ca],
                 jnp.ones(len(cfg.cat_positions), jnp.bool_), cfg,
                 out_lower=out_lower, out_upper=out_upper,
-                cegb_pen=(None if cegb_pen is None else cegb_pen[ca]))
+                cegb_pen=(None if cegb_pen is None else cegb_pen[ca]),
+                parent_out=parent_out,
+                contri=(None if contri is None else contri[ca]))
             pf_cat = jnp.full(pf.shape[0], NEG_INF).at[ca].set(
                 jnp.max(all_gain_c, axis=(1, 2)))
             pf = jnp.maximum(pf, pf_cat)
@@ -383,7 +474,7 @@ def per_feature_gains(hist: jax.Array, parent_sums: jax.Array,
             all_gain, _, _, _ = _categorical_candidates(
                 hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
                 out_lower=out_lower, out_upper=out_upper,
-                cegb_pen=cegb_pen)
+                cegb_pen=cegb_pen, parent_out=parent_out, contri=contri)
             pf = jnp.maximum(pf, jnp.max(all_gain, axis=(1, 2)))
     return pf
 
@@ -412,8 +503,9 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
                     cfg: SplitConfig,
                     is_cat: jax.Array = None, mono=None,
                     out_lower=None, out_upper=None,
-                    cegb_pen: jax.Array = None
-                    ) -> Dict[str, jax.Array]:
+                    cegb_pen: jax.Array = None,
+                    parent_out=None, extra_u=None, contri=None,
+                    depth=None) -> Dict[str, jax.Array]:
     """Best split for one leaf given its histogram.
 
     Args:
@@ -442,7 +534,10 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
     gain, left = _numerical_candidates(hist, parent_sums, num_bin,
                                        has_nan, num_allowed, cfg,
                                        mono=mono, out_lower=out_lower,
-                                       out_upper=out_upper)
+                                       out_upper=out_upper,
+                                       parent_out=parent_out,
+                                       extra_u=extra_u, contri=contri,
+                                       depth=depth)
     if cfg.has_cegb:
         # CEGB gain discount; candidates whose PENALIZED gain no longer
         # clears min_gain_to_split are rejected (the actual pruning)
@@ -469,13 +564,15 @@ def find_best_split(hist: jax.Array, parent_sums: jax.Array,
                 hist[ca], parent_sums, num_bin[ca], allowed_feature[ca],
                 jnp.ones(len(cfg.cat_positions), jnp.bool_), cfg,
                 out_lower=out_lower, out_upper=out_upper,
-                cegb_pen=(None if cegb_pen is None else cegb_pen[ca]))
+                cegb_pen=(None if cegb_pen is None else cegb_pen[ca]),
+                parent_out=parent_out,
+                contri=(None if contri is None else contri[ca]))
             cfeat = ca[cfeat_l]
         else:
             cgain, cfeat, cleft, cinset = _categorical_best(
                 hist, parent_sums, num_bin, allowed_feature, is_cat, cfg,
                 out_lower=out_lower, out_upper=out_upper,
-                cegb_pen=cegb_pen)
+                cegb_pen=cegb_pen, parent_out=parent_out, contri=contri)
         take_cat = cgain > best_gain
         best_gain = jnp.maximum(best_gain, cgain)
         feature = jnp.where(take_cat, cfeat, feature)

@@ -18,9 +18,14 @@ must be consistent across processes — share the bin mappers (e.g.
 ``Dataset.save_binary`` on rank 0, or identical
 ``bin_construct_sample_cnt`` sampling of a common sample file).
 
-Validated in this repo on single-host (the driver's virtual 8-device
-mesh); the multi-host ingestion follows JAX's documented global-array
-recipe but has no multi-host CI here.
+Validated by a REAL 2-process localhost run in CI
+(tests/test_multihost.py): two processes join one ``jax.distributed``
+job on the CPU backend, each ingests its own row shard binned against a
+shared reference dataset, trains ``tree_learner=data``, and the model
+matches a single-process run on the same global data. Mean-statistic
+init scores (L2/binary/poisson family) sync across processes like the
+reference's ``Network::GlobalSyncUpByMean`` (boosting/gbdt.py);
+percentile-based init scores warn and use the local shard.
 """
 from __future__ import annotations
 

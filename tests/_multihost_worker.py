@@ -1,0 +1,74 @@
+"""Worker process for the 2-process localhost multi-host test.
+
+Usage: python _multihost_worker.py RANK NPROC PORT OUT_MODEL
+
+RANK >= 0: join a ``jax.distributed`` job of NPROC localhost processes
+(the reference's own distributed test strategy — spawning local CLI
+processes against 127.0.0.1 sockets, tests/distributed/_test_distributed
+.py per SURVEY.md §4) and train ``tree_learner=data`` with THIS
+process's row shard only, binned against a shared reference dataset.
+RANK == -1: single-process baseline on NPROC fake CPU devices (set via
+XLA_FLAGS by the caller) over the full data — the same SPMD program on
+the same global array, so results must match the multi-process run.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # repo root -> lightgbm_tpu
+
+
+def make_data():
+    import numpy as np
+    rng = np.random.default_rng(0)
+    n, f = 4096, 8
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + rng.normal(scale=0.3, size=n) > 0).astype(float)
+    return X, y
+
+
+def main():
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = int(sys.argv[3])
+    out_model = sys.argv[4]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")   # env alone is ignored
+    if rank >= 0:
+        from lightgbm_tpu.parallel.multihost import init_multihost
+        init_multihost(f"localhost:{port}", nproc, rank)
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    X, y = make_data()
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "verbosity": -1,
+              "tree_learner": "data", "tpu_double_precision_hist": True}
+
+    if rank >= 0:
+        # consistent binning across processes: every process builds the
+        # SAME reference dataset from the same (deterministic) sample,
+        # then bins its own row shard against it — the documented
+        # bin-mapper-sharing recipe (parallel/multihost.py)
+        ref = lgb.Dataset(X, params=dict(params))
+        ref.construct()
+        n = len(X)
+        blk = n // nproc
+        lo, hi = rank * blk, (rank + 1) * blk
+        ds = lgb.Dataset(X[lo:hi], label=y[lo:hi], reference=ref,
+                         params=dict(params))
+    else:
+        ds = lgb.Dataset(X, label=y, params=dict(params))
+
+    bst = lgb.train(params, ds, num_boost_round=5)
+    if rank <= 0:
+        with open(out_model, "w") as fh:
+            fh.write(bst.model_to_string())
+    print(f"worker rank={rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -5,16 +5,25 @@ reference spawns N localhost CLI processes for its distributed tests; we
 give XLA 8 fake host devices so sharded/distributed paths execute real
 collectives in-process.
 
-NOTE: this environment's site config pins ``jax_platforms=axon,cpu`` (one
-real TPU via tunnel), so JAX_PLATFORMS env alone is ignored — we must
-override through jax.config BEFORE any device is initialized.
+``LGBM_TPU_TESTS=1`` skips the CPU pin so the suite runs against the
+REAL TPU backend — this is how the Pallas-kernel equivalence tests
+(test_multi_leaf_histogram.py's ``requires_tpu`` cases) execute on the
+hardware they target; run ``LGBM_TPU_TESTS=1 python -m pytest tests/``
+once per round. Distributed tests self-skip there (one real chip).
+
+NOTE: this environment's site config pins ``jax_platforms=axon,cpu``
+(one real TPU via tunnel), so JAX_PLATFORMS env alone is ignored — we
+must override through jax.config BEFORE any device is initialized.
 """
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+TPU_MODE = os.environ.get("LGBM_TPU_TESTS", "") == "1"
+
+if not TPU_MODE:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 # persistent compilation cache: grow_tree's while_loop is expensive to
 # compile; cache across test runs keeps the suite fast
@@ -24,6 +33,7 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.device_count() == 8, (
-    f"expected 8 fake CPU devices, got {jax.devices()}")
+if not TPU_MODE:
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() == 8, (
+        f"expected 8 fake CPU devices, got {jax.devices()}")

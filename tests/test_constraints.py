@@ -110,6 +110,73 @@ def test_interaction_constraints_list_form():
     assert bst.num_trees() == 5
 
 
+def test_monotone_intermediate_enforced_and_tighter_fit():
+    """IntermediateLeafConstraints: same monotonicity guarantee as
+    basic, but sibling-output bounds (recomputed per round from current
+    outputs) are looser than basic's midpoint — the constrained fit must
+    not get worse, and typically improves."""
+    X, y = _data(n=6000, seed=7)
+    grid = np.linspace(-2, 2, 201)
+    params = {"objective": "regression", "num_leaves": 31,
+              "verbosity": -1, "monotone_constraints": [1, 0, 0, 0]}
+    basic = lgb.train({**params, "monotone_constraints_method": "basic"},
+                      lgb.Dataset(X, label=y), num_boost_round=60)
+    inter = lgb.train({**params,
+                       "monotone_constraints_method": "intermediate"},
+                      lgb.Dataset(X, label=y), num_boost_round=60)
+    rng = np.random.default_rng(8)
+    for _ in range(8):
+        row = rng.uniform(-2, 2, size=4)
+        r = _response_curve(inter, row, 0, grid)
+        assert np.min(np.diff(r)) >= -1e-6, "intermediate violates"
+    mse_b = float(np.mean((basic.predict(X) - y) ** 2))
+    mse_i = float(np.mean((inter.predict(X) - y) ** 2))
+    # looser bounds can only help the training fit (tolerance for ties)
+    assert mse_i <= mse_b * 1.02, (mse_i, mse_b)
+
+
+def test_monotone_penalty_pushes_constrained_splits_down():
+    """ComputeMonotoneSplitGainPenalty: a large penalty makes the
+    constrained feature unusable near the root."""
+    X, y = _data(n=4000, seed=9)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "monotone_constraints": [1, 0, 0, 0]}
+    plain = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=10)
+    pen = lgb.train({**base, "monotone_penalty": 2.0},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+
+    def min_depth_of_feature(bst, feat):
+        """Shallowest depth (root=0) at which `feat` splits, across
+        trees."""
+        best = np.inf
+        used_map = bst.engine.train_set.used_features
+
+        def walk(t, node, d):
+            nonlocal best
+            if node < 0:
+                return
+            if used_map[int(t.split_feature[node])] == feat:
+                best = min(best, d)
+            walk(t, int(t.left_child[node]), d + 1)
+            walk(t, int(t.right_child[node]), d + 1)
+
+        for t in bst.engine.models:
+            if t.num_nodes:
+                walk(t, 0, 0)
+        return best
+
+    d_plain = min_depth_of_feature(plain, 0)
+    d_pen = min_depth_of_feature(pen, 0)
+    # penalty 2.0 zeroes gains at depths 0 (factor ~eps while
+    # penalization >= depth+1), so f0 cannot be the root split
+    assert d_plain == 0
+    assert d_pen >= 1, (d_plain, d_pen)
+    # monotonicity still holds under the penalty
+    grid = np.linspace(-2, 2, 101)
+    r = _response_curve(pen, np.zeros(4), 0, grid)
+    assert np.min(np.diff(r)) >= -1e-6
+
+
 def test_monotone_with_data_parallel():
     X, y = _data(seed=5)
     bst = lgb.train(

@@ -81,9 +81,10 @@ def test_compact_engine_flag_and_fallbacks():
 
 
 def test_goss_selects_exact_counts():
-    """GOSS parity property (goss.hpp): exactly round(a*n_valid) top
-    rows and exactly round(b*n_valid) random rows are selected every
-    iteration, even with heavily tied |g*h| metrics."""
+    """GOSS parity property (goss.hpp): exactly floor(a*n_valid) top
+    rows and exactly floor(b*n_valid) random rows are selected every
+    iteration (the reference static_casts, i.e. truncates), even with
+    heavily tied |g*h| metrics."""
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
     rng = np.random.default_rng(5)
@@ -100,8 +101,8 @@ def test_goss_selects_exact_counts():
     for _ in range(3):
         eng.train_one_iter()
     n_valid = int(np.asarray(eng.data.valid_mask).sum())
-    k_top = int(round(0.25 * n_valid))
-    k_rand = int(round(0.15 * n_valid))   # engine rounds, then caps
+    k_top = int(0.25 * n_valid)
+    k_rand = int(0.15 * n_valid)    # engine truncates, then caps
     # engine-level check: run a GOSS iteration and inspect leaf counts
     eng.train_one_iter()
     t = eng.models[-1]

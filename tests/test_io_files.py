@@ -154,6 +154,36 @@ def test_cli_train_and_predict(tmp_path):
     assert np.mean((preds > 0.5) == y[800:]) > 0.8
 
 
+def test_cli_refit_matches_python_refit(tmp_path):
+    """task=refit must call Booster.refit (gbdt.cpp::RefitTree — re-fit
+    existing leaf values, NOT training continuation): tree count is
+    unchanged and output equals the Python refit path."""
+    from lightgbm_tpu.app import run
+    X, y = _data(n=1000)
+    train_path = str(tmp_path / "train.csv")
+    _write_csv(train_path, X[:700], y[:700])
+    refit_path = str(tmp_path / "refit.csv")
+    _write_csv(refit_path, X[700:], y[700:])
+    model_path = str(tmp_path / "model.txt")
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X[:700], label=y[:700]),
+                    num_boost_round=8)
+    bst.save_model(model_path)
+    out_path = str(tmp_path / "refitted.txt")
+    assert run(["task=refit", f"data={refit_path}",
+                f"input_model={model_path}", f"output_model={out_path}",
+                "refit_decay_rate=0.8", "verbosity=-1"]) == 0
+    cli_bst = lgb.Booster(model_file=out_path)
+    # same number of trees — refit never adds iterations
+    assert cli_bst.num_trees() == bst.num_trees()
+    py_bst = lgb.Booster(model_file=model_path).refit(
+        X[700:], y[700:], decay_rate=0.8)
+    np.testing.assert_allclose(cli_bst.predict(X), py_bst.predict(X),
+                               rtol=1e-6, atol=1e-6)
+    # and it actually changed the leaves vs the original model
+    assert not np.allclose(cli_bst.predict(X), bst.predict(X))
+
+
 def test_cli_save_binary(tmp_path):
     from lightgbm_tpu.app import run
     X, y = _data(n=300)

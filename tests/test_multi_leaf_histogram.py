@@ -49,8 +49,41 @@ def test_multi_leaf_xla_matches_single_leaf_oracle():
     assert np.all(out[2] == 0.0)
 
 
-@pytest.mark.skipif(jax.default_backend() != "tpu",
-                    reason="Pallas TPU kernel needs a TPU backend")
+requires_tpu = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="Pallas TPU kernel needs a TPU backend (run with "
+           "LGBM_TPU_TESTS=1 on the chip)")
+
+
+@requires_tpu
+@pytest.mark.parametrize(
+    "F,B,rpb",
+    [
+        (40, 256, 2048),   # F*B = 10240 > 8192: feature-blocked grid,
+                           # at the B=256 int8-roundtrip boundary
+        (8, 256, 4096),    # B=256 boundary on the single-block path at
+                           # the R=4096 cap
+        (64, 128, 2048),   # wide-F grid at the reduced R cap
+        (6, 32, 4096),     # narrow shape at the full R cap
+    ])
+def test_pallas_matches_xla_boundary_shapes(F, B, rpb):
+    """The exact VMEM cliffs docs/perf.md documents: the feature-blocked
+    grid (F*B > 8192), the 256-bin int8 round-trip boundary, and both
+    rows-per-block caps — each must agree with the XLA reference."""
+    bins, vals, leaf_id = _data(n=4096, F=F, B=B, seed=B + F)
+    small_ids = np.array([0, 3, -1, 1], dtype=np.int32)
+    bins_t = np.ascontiguousarray(bins.T).astype(np.int8)
+    h_pl = np.asarray(multi_leaf_histogram(
+        jnp.asarray(bins_t), jnp.asarray(vals.T), jnp.asarray(leaf_id),
+        jnp.asarray(small_ids), num_bins=B, rows_per_block=rpb))
+    h_xla = np.asarray(multi_leaf_histogram_xla(
+        jnp.asarray(bins), jnp.asarray(vals), jnp.asarray(leaf_id),
+        jnp.asarray(small_ids), num_bins=B, rows_per_block=rpb))
+    np.testing.assert_allclose(h_pl, h_xla, rtol=2e-2, atol=0.5)
+    np.testing.assert_array_equal(h_pl[..., 2], h_xla[..., 2])
+
+
+@requires_tpu
 def test_pallas_matches_xla():
     B = 64
     bins, vals, leaf_id = _data(n=4096, F=8, B=B, seed=1)

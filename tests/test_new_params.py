@@ -1,0 +1,259 @@
+"""Round-3 parameter coverage: path_smooth, extra_trees,
+feature_contri, reg_sqrt, stochastic_rounding, importance type, and the
+zero-silently-ignored-params contract (VERDICT r2 item 6).
+
+Reference semantics (UNVERIFIED — empty mount): feature_histogram.hpp
+(USE_SMOOTHING, USE_RAND_SEED / extra_trees, feature penalty),
+regression_objective.hpp (sqrt mode), config_auto.cpp (every documented
+param acts)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=3000, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = X @ rng.normal(size=f) + rng.normal(scale=0.3, size=n)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# path_smooth
+# ---------------------------------------------------------------------------
+def test_path_smooth_changes_model_and_shrinks_leaves():
+    X, y = _data()
+    plain = lgb.train({"objective": "regression", "num_leaves": 31,
+                       "verbosity": -1}, lgb.Dataset(X, label=y),
+                      num_boost_round=10)
+    smooth = lgb.train({"objective": "regression", "num_leaves": 31,
+                        "path_smooth": 50.0, "verbosity": -1},
+                       lgb.Dataset(X, label=y), num_boost_round=10)
+    p0, p1 = plain.predict(X), smooth.predict(X)
+    assert not np.allclose(p0, p1)
+    # smoothing pulls leaf outputs toward parents -> lower variance of
+    # per-tree leaf values in the very first tree
+    t0 = plain.engine.models[0]
+    t1 = smooth.engine.models[0]
+    n0 = int(np.asarray(t0.num_leaves))
+    n1 = int(np.asarray(t1.num_leaves))
+    v0 = np.asarray(t0.leaf_value)[:n0]
+    v1 = np.asarray(t1.leaf_value)[:n1]
+    assert np.std(v1) < np.std(v0)
+    # still a sane model
+    assert np.corrcoef(p1, y)[0, 1] > 0.9
+
+
+def test_path_smooth_zero_is_noop():
+    X, y = _data(seed=1)
+    a = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=5)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "path_smooth": 0.0, "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+# ---------------------------------------------------------------------------
+# extra_trees
+# ---------------------------------------------------------------------------
+def test_extra_trees_randomizes_thresholds():
+    X, y = _data(seed=2)
+    plain = lgb.train({"objective": "regression", "num_leaves": 31,
+                       "verbosity": -1}, lgb.Dataset(X, label=y),
+                      num_boost_round=8)
+    extra = lgb.train({"objective": "regression", "num_leaves": 31,
+                       "extra_trees": True, "verbosity": -1},
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    assert not np.allclose(plain.predict(X), extra.predict(X))
+    # random single thresholds fit train data no better than full scans
+    mse_p = np.mean((plain.predict(X) - y) ** 2)
+    mse_e = np.mean((extra.predict(X) - y) ** 2)
+    assert mse_e >= mse_p * 0.99
+    # extra_seed changes the drawn thresholds
+    extra2 = lgb.train({"objective": "regression", "num_leaves": 31,
+                        "extra_trees": True, "extra_seed": 99,
+                        "verbosity": -1},
+                       lgb.Dataset(X, label=y), num_boost_round=8)
+    assert not np.allclose(extra.predict(X), extra2.predict(X))
+
+
+def test_extra_trees_same_seed_deterministic():
+    X, y = _data(seed=3)
+    ps = {"objective": "regression", "num_leaves": 15,
+          "extra_trees": True, "verbosity": -1}
+    a = lgb.train(ps, lgb.Dataset(X, label=y), num_boost_round=5)
+    b = lgb.train(ps, lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+# ---------------------------------------------------------------------------
+# feature_contri
+# ---------------------------------------------------------------------------
+def test_feature_contri_suppresses_feature():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(3000, 3))
+    # f0 dominates; near-zero contri should demote it
+    y = 3.0 * X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.1, size=3000)
+    plain = lgb.train({"objective": "regression", "num_leaves": 15,
+                       "verbosity": -1}, lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+    demoted = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "feature_contri": [1e-6, 1.0, 1.0],
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+
+    def root_feature(bst):
+        t = bst.engine.models[0]
+        return bst.engine.train_set.used_features[int(t.split_feature[0])]
+
+    assert root_feature(plain) == 0
+    assert root_feature(demoted) != 0
+    # all-ones contri is a no-op
+    ones = lgb.train({"objective": "regression", "num_leaves": 15,
+                      "feature_contri": [1.0, 1.0, 1.0],
+                      "verbosity": -1},
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_array_equal(plain.predict(X), ones.predict(X))
+
+
+# ---------------------------------------------------------------------------
+# reg_sqrt
+# ---------------------------------------------------------------------------
+def test_reg_sqrt_roundtrip_and_transform():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(3000, 4))
+    y = np.exp(X[:, 0] + 0.2 * X[:, 1])      # heavy-tailed positive
+    bst = lgb.train({"objective": "regression", "reg_sqrt": True,
+                     "num_leaves": 31, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    pred = bst.predict(X)
+    # predictions come back in label space (sign(s) * s^2)
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
+    raw = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, np.sign(raw) * raw * raw,
+                               rtol=1e-6)
+    # model text round-trip keeps the sqrt transform
+    s = bst.model_to_string()
+    assert "objective=regression sqrt" in s
+    re_bst = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(re_bst.predict(X), pred, rtol=1e-5,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# stochastic_rounding
+# ---------------------------------------------------------------------------
+def test_stochastic_rounding_off_is_deterministic_rounding():
+    X, y = _data(seed=6)
+    ps = {"objective": "regression", "num_leaves": 15,
+          "use_quantized_grad": True, "verbosity": -1,
+          "stochastic_rounding": False}
+    a = lgb.train(ps, lgb.Dataset(X, label=y), num_boost_round=5)
+    b = lgb.train({**ps, "seed": 123}, lgb.Dataset(X, label=y),
+                  num_boost_round=5)
+    # without stochastic rounding the quantization ignores the RNG seed
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+    on = lgb.train({**ps, "stochastic_rounding": True},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    assert not np.allclose(a.predict(X), on.predict(X))
+
+
+# ---------------------------------------------------------------------------
+# saved_feature_importance_type
+# ---------------------------------------------------------------------------
+def test_saved_importance_type_gain():
+    X, y = _data(seed=7)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "saved_feature_importance_type": 1,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    txt = bst.model_to_string()
+    sec = txt.split("feature_importances:\n")[1].split("\n\n")[0]
+    vals = [float(line.split("=")[1]) for line in sec.strip().splitlines()]
+    # gain importances are non-integer in general
+    assert any(abs(v - round(v)) > 1e-9 for v in vals), vals
+
+
+# ---------------------------------------------------------------------------
+# unimplemented params warn (never silently ignored)
+# ---------------------------------------------------------------------------
+def test_tpu_debug_catches_nan_custom_objective():
+    """VERDICT r2 item 10: a NaN-producing custom objective must raise
+    an actionable error with iteration context instead of silently
+    training NaN trees."""
+    X, y = _data(seed=8)
+
+    def bad_fobj(preds, ds):
+        g = preds - ds.get_label()
+        g = np.where(np.arange(len(g)) == 7, np.nan, g)
+        return g, np.ones_like(g)
+
+    with pytest.raises(lgb.LightGBMError, match="tpu_debug at iteration"):
+        lgb.train({"objective": "custom", "tpu_debug": True,
+                   "num_leaves": 15, "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3,
+                  fobj=bad_fobj)
+
+
+def test_tpu_debug_catches_nan_labels_on_device():
+    """Built-in objective fed poisoned labels: the checkify pass flags
+    non-finite gradients on-device."""
+    X, y = _data(seed=9)
+    y = y.copy()
+    y[3] = np.nan
+    with pytest.raises(lgb.LightGBMError, match="non-finite"):
+        lgb.train({"objective": "regression", "tpu_debug": True,
+                   "num_leaves": 15, "verbosity": -1,
+                   "boost_from_average": False},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+
+
+def test_tpu_debug_clean_run_unaffected():
+    X, y = _data(seed=10)
+    a = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=4)
+    b = lgb.train({"objective": "regression", "tpu_debug": True,
+                   "num_leaves": 15, "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_sparse_predict_without_densify():
+    """VERDICT r2 item 9: predict on scipy input must bin column-wise
+    (engine path) / chunk rows (host-model path) and match the dense
+    result exactly."""
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    rng = np.random.default_rng(11)
+    Xd = rng.normal(size=(2000, 10))
+    Xd[rng.random(Xd.shape) < 0.8] = 0.0       # sparse-ish, zeros real
+    y = (Xd[:, 0] + Xd[:, 1] > 0).astype(float)
+    Xs = scipy_sparse.csr_matrix(Xd)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(Xs, label=y),
+                    num_boost_round=5)
+    p_dense = bst.predict(Xd)
+    p_sparse = bst.predict(Xs)                 # engine path
+    np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-7)
+    # host-model path (loaded booster) chunks sparse rows
+    hm = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(hm.predict(Xs), hm.predict(Xd),
+                               rtol=1e-7)
+
+
+def test_unimplemented_param_warns():
+    from lightgbm_tpu.config import Config, _WARNED_UNIMPLEMENTED
+    from lightgbm_tpu.utils import log
+    _WARNED_UNIMPLEMENTED.discard("forcedsplits_filename")
+    msgs = []
+    log.register_callback(msgs.append)
+    try:
+        Config({"objective": "binary", "verbosity": 1,
+                "forcedsplits_filename": "splits.json"})
+    finally:
+        log.register_callback(None)
+        log.set_verbosity(-1)
+    assert any("forcedsplits_filename" in m for m in msgs), msgs

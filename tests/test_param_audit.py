@@ -1,0 +1,59 @@
+"""The zero-silently-ignored-params contract (VERDICT r2 item 6).
+
+Every entry in the config table must be one of:
+  1. consumed somewhere in package source (outside config.py's table),
+  2. declared UNIMPLEMENTED (warns when set to a non-default value), or
+  3. declared DISSOLVED (an implementation hint whose correct TPU/XLA
+     behavior is "no action", with a recorded rationale).
+
+Reference: upstream honors every documented param via config_auto.cpp
+(SURVEY.md:88) — this test is the enforcement mechanism for that parity
+claim at param granularity."""
+import inspect
+import pathlib
+import re
+
+import lightgbm_tpu.config as C
+
+
+def _package_source_without_param_table() -> str:
+    pkg = pathlib.Path(C.__file__).parent
+    src = []
+    for p in sorted(pkg.rglob("*.py")):
+        if p.name == "config.py":
+            continue
+        src.append(p.read_text())
+    # config.py consumes some params itself (CheckParamConflict fixups),
+    # but its _PARAMS table mentions every name — include only the
+    # consuming code, not the table
+    src.append(inspect.getsource(C.Config._post_process))
+    src.append(inspect.getsource(type(C.Config(
+        {"verbosity": -1})).num_tree_per_iteration.fget))
+    return "\n".join(src)
+
+
+def test_every_param_consumed_warned_or_dissolved():
+    src = _package_source_without_param_table()
+    unaccounted = []
+    for name in C.Config.param_names():
+        if name in C.UNIMPLEMENTED_PARAMS:
+            continue
+        if name in C.DISSOLVED_PARAMS:
+            continue
+        if not re.search(rf"\b{name}\b", src):
+            unaccounted.append(name)
+    assert not unaccounted, (
+        f"params neither consumed in source nor declared in "
+        f"UNIMPLEMENTED_PARAMS/DISSOLVED_PARAMS: {unaccounted}")
+
+
+def test_tables_are_disjoint_and_valid():
+    names = set(C.Config.param_names())
+    unimp = set(C.UNIMPLEMENTED_PARAMS)
+    diss = set(C.DISSOLVED_PARAMS)
+    assert unimp <= names, unimp - names
+    assert diss <= names, diss - names
+    assert not (unimp & diss)
+    # every dissolved rationale is a real sentence, not a stub
+    for k, v in {**C.UNIMPLEMENTED_PARAMS, **C.DISSOLVED_PARAMS}.items():
+        assert len(v) > 15, (k, v)

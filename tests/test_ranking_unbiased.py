@@ -89,6 +89,44 @@ def test_unbiased_rejects_distributed():
                   num_boost_round=2)
 
 
+def test_positions_auto_enable_debiasing():
+    """Reference behavior: a `position` field activates debiasing with
+    NO flag (rank_objective.hpp position_bias_ — UNVERIFIED). A config
+    ported from the reference with positions set must not silently
+    train biased."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    X, y, group = _rank_data(seed=3)
+    rng = np.random.default_rng(1)
+    pos = np.concatenate([rng.permutation(24) for _ in range(40)])
+    ds = lgb.Dataset(X, label=y, group=group)
+    ds.set_field("position", pos)
+    cfg = Config({"objective": "lambdarank", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "verbosity": -1})
+    eng = GBDT(cfg, ds)                  # no lambdarank_unbiased flag
+    assert eng.objective.unbiased
+    assert eng._pos_state is not None
+    assert eng._pos_state.shape == (2, 24)
+    for _ in range(3):
+        eng.train_one_iter()
+    assert np.isfinite(np.asarray(eng._pos_state)).all()
+
+
+def test_bias_reg_derives_exponent():
+    """Propensity exponent follows the reference's 1/(1+regularization)
+    unless lambdarank_bias_p_norm >= 0 overrides it."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objective.ranking import LambdaRank
+    o = LambdaRank(Config({"objective": "lambdarank",
+                           "lambdarank_position_bias_regularization": 1.0,
+                           "verbosity": -1}))
+    assert o.bias_p_norm == 0.5
+    o2 = LambdaRank(Config({"objective": "lambdarank",
+                            "lambdarank_bias_p_norm": 0.25,
+                            "verbosity": -1}))
+    assert o2.bias_p_norm == 0.25
+
+
 def test_explicit_positions_consumed():
     """With a `position` field, propensities index by presentation
     position (Metadata::positions, v4.2+) instead of score rank —
