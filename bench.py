@@ -92,9 +92,13 @@ def main():
     eng = GBDT(cfg, ds)
     bin_time = time.time() - t_bin
 
-    # warmup (jit compile + cache); same chunk length as the timed run so
-    # the fused scan is compiled exactly once
-    eng.train_chunk(args.iters if args.warmup is None else args.warmup)
+    # warmup (jit compile + cache); same chunk length as the timed run
+    # so the fused scan is compiled exactly once. GOSS keeps the first
+    # 1/learning_rate iterations unsampled (goss.hpp warmup), so its
+    # warmup extends past them to reach the fused GOSS chunk.
+    if args.warmup is None:
+        args.warmup = args.iters + (10 if args.goss else 0)
+    eng.train_chunk(args.warmup)
     import jax
     jax.block_until_ready(eng.score)
 

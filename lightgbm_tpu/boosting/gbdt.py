@@ -566,6 +566,15 @@ class GBDT:
             precise_histogram=config.tpu_double_precision_hist,
             leaf_batch=max(1, config.tpu_leaf_batch),
             use_pallas=self.use_pallas,
+            # int8 histogram path: stochastic rounding can push a level
+            # to qbins, so int8 needs num_grad_quant_bins <= 127; the
+            # int32 accumulator must also hold qbins * n_rows without
+            # wrapping (the bf16 path degrades gracefully there instead)
+            int_hist=(self.use_pallas
+                      and bool(config.use_quantized_grad)
+                      and int(config.num_grad_quant_bins) <= 127
+                      and self.data.n_pad
+                      * int(config.num_grad_quant_bins) < 2**31),
             axis_name=(self.axis if self.mesh is not None
                        and not self._shard_features else ""),
             has_categorical=self.has_categorical,
@@ -659,6 +668,23 @@ class GBDT:
                                jnp.asarray(1.0, jnp.float32)])
             return gq, hq, scale
 
+        def leaf_contrib(tree, leaf_id):
+            """Per-row leaf_value[leaf_id] * lr. As a one-hot matmul: a
+            per-row gather into a [L] table runs on the TPU scalar unit
+            (~9ms/Mrow); the masked contraction is ~free on the MXU. The
+            one-hot operand is O(n*L), so fall back to the gather for
+            very wide trees where it would dominate HBM."""
+            Lq = tree["leaf_value"].shape[0]
+            if Lq <= 512:
+                onehot = (leaf_id[:, None]
+                          == jnp.arange(Lq, dtype=jnp.int32)[None, :])
+                return jax.lax.dot_general(
+                    onehot.astype(jnp.float32),
+                    tree["leaf_value"][:, None],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST)[:, 0] * lr
+            return tree["leaf_value"][leaf_id] * lr
+
         def grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
                      allowed, qkey=None, cegb_pen=None):
             trees, leaf_ids = [], []
@@ -707,23 +733,8 @@ class GBDT:
                     tree["leaf_value"] = jnp.where(
                         tree["leaf_count"] > 0, renewed,
                         tree["leaf_value"])
-                # leaf_value[leaf_id] as a one-hot matmul: a per-row
-                # gather into a [L] table runs on the TPU scalar unit
-                # (~9ms/Mrow); the masked contraction is ~free on the MXU.
-                # The one-hot operand is O(n*L), so fall back to the
-                # gather for very wide trees where it would dominate HBM.
-                L = tree["leaf_value"].shape[0]
-                if L <= 512:
-                    onehot = (leaf_id[:, None]
-                              == jnp.arange(L, dtype=jnp.int32)[None, :])
-                    contrib = jax.lax.dot_general(
-                        onehot.astype(jnp.float32),
-                        tree["leaf_value"][:, None],
-                        dimension_numbers=(((1,), (0,)), ((), ())),
-                        precision=jax.lax.Precision.HIGHEST)[:, 0] * lr
-                else:
-                    contrib = tree["leaf_value"][leaf_id] * lr
-                new_score = new_score.at[:, k].add(contrib)
+                new_score = new_score.at[:, k].add(
+                    leaf_contrib(tree, leaf_id))
                 trees.append(tree)
                 leaf_ids.append(leaf_id)
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
@@ -898,15 +909,18 @@ class GBDT:
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
                             allowed, qkey=key, cegb_pen=cegb_pen)
 
-        # ---- GOSS physical row compaction (tpu_goss_compact) -----------
+        # ---- GOSS histogram-only compaction (tpu_goss_compact) ---------
         # The masked formulation scans ALL rows with zero weights; the
         # reference's GOSS scans only the sampled subset
-        # (goss.hpp bag_data_indices_). Here: fixed-size gather of the
-        # sampled rows (static n_sub >= worst-case sample), tree growth
-        # on the compacted arrays, and full-data score updates by tree
-        # traversal (the same path valid-set eval uses). Sample choice is
-        # bit-identical to the masked path (same RNG stream); histogram
-        # float sums may differ only in accumulation order.
+        # (goss.hpp bag_data_indices_). Here: ONE lax.sort moves the
+        # sampled rows into a fixed-size front buffer (static n_sub >=
+        # worst-case sample), HISTOGRAMS scan only that buffer, and the
+        # full-row leaf_id partition + one-hot score update stay exactly
+        # as in the masked path (perf.md measured them cheap — the
+        # round-2 traversal-based score update is what made full
+        # compaction lose). Sample choice is bit-identical to the
+        # masked path (same RNG stream); histogram float sums may
+        # differ only in accumulation order (exact in quantized mode).
         renews_obj = (type(obj).renew_tree_output
                       is not Objective.renew_tree_output)
         use_goss_compact = (bool(self.config.tpu_goss_compact)
@@ -980,6 +994,8 @@ class GBDT:
                 bins_t_c = (bins_c.astype(jnp.int8).T
                             if bins_t is not None else None)
                 qkey = jax.random.fold_in(key, 0x9e37)
+                import dataclasses as _dc
+                gcfg_c = _dc.replace(gcfg, hist_compact=True)
                 trees, leaf_ids = [], []
                 new_score = score
                 for k in range(K):
@@ -989,24 +1005,24 @@ class GBDT:
                     if use_quant:
                         kq = jax.random.fold_in(qkey, k)
                         gk, hk, chan_scale = quantize(gk, hk, mc_c, kq)
-                    vals = jnp.stack([gk, hk, mc_c], axis=1)
-                    tree, leaf_id_c = grow_tree(
-                        bins_c, vals, self.feat_num_bin,
-                        self.feat_has_nan, allowed, gcfg,
-                        bins_t=bins_t_c, is_cat=self.feat_is_cat,
+                    vals_c = jnp.stack([gk, hk, mc_c], axis=1)
+                    tree, leaf_id = grow_tree(
+                        bins, vals_c, self.feat_num_bin,
+                        self.feat_has_nan, allowed, gcfg_c,
+                        bins_t=bins_t, is_cat=self.feat_is_cat,
                         mono=self.feat_mono,
                         groups=self.interaction_groups,
                         chan_scale=chan_scale,
                         node_key=jax.random.fold_in(qkey, 0xB14D + k),
-                        cegb_pen=cegb_pen, contri=self.feat_contri)
-                    # full-data score update by traversal — unsampled
-                    # rows need this iteration's tree too
-                    vals_full, _ = tree_predict_binned(
-                        tree, bins, self.feat_num_bin,
-                        self.feat_has_nan)
-                    new_score = new_score.at[:, k].add(vals_full * lr)
+                        cegb_pen=cegb_pen, contri=self.feat_contri,
+                        compact=(bins_c, bins_t_c, vals_c))
+                    # FULL leaf ids came from the in-loop partition; the
+                    # score update is the same one-hot matmul as the
+                    # masked path (no per-row traversal)
+                    new_score = new_score.at[:, k].add(
+                        leaf_contrib(tree, leaf_id))
                     trees.append(tree)
-                    leaf_ids.append(leaf_id_c)
+                    leaf_ids.append(leaf_id)
                 stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
                 return stacked, jnp.stack(leaf_ids), new_score
 

@@ -248,12 +248,14 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # order, larger batches fuse K leaf histograms into one data scan
     "tpu_leaf_batch": _P("int", 32, [], (1, 256)),
     "tpu_use_pallas": _P("bool", True),
-    # GOSS physical row compaction: gather the sampled rows into a
-    # fixed-size buffer so histogram scans shrink to ~(top+other)*n
-    # rows (the reference's bag subsets rows physically; the default
-    # masked formulation scans everything with zero weights). Scores
-    # for unsampled rows update via tree traversal.
-    "tpu_goss_compact": _P("bool", False),
+    # GOSS histogram-only row compaction (default on): one sort moves
+    # the sampled rows into a fixed-size buffer so HISTOGRAM scans
+    # shrink to ~(top+other)*n rows (the reference's bag subsets rows
+    # physically; the masked formulation scans everything with zero
+    # weights); the full-row partition/score update stays masked.
+    # Falls back to the masked path for meshes/EFB/linear trees/leaf
+    # renewal objectives.
+    "tpu_goss_compact": _P("bool", True),
     # boosting iterations fused into one device dispatch (lax.scan) when
     # the pure-jit path applies (no callbacks/valid sets/host bagging)
     "tpu_fuse_iters": _P("int", 40, [], (1, 1000)),

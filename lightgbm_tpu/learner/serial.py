@@ -62,6 +62,14 @@ class GrowConfig:
     leaf_batch: int = 1
     # use the fused Pallas kernel (TPU) vs the XLA einsum fallback
     use_pallas: bool = False
+    # quantized-gradient int8 x int8 -> int32 kernel variant (exact
+    # integer accumulation at 2x MXU rate); only valid when vals carry
+    # small integer levels (use_quantized_grad, engine-enforced)
+    int_hist: bool = False
+    # GOSS histogram-only compaction: histograms scan the compacted
+    # sampled-row buffer (grow_tree's `compact` argument) while the
+    # full-row partition/score path stays masked
+    hist_compact: bool = False
     # mesh axis for data-parallel histogram reduction ("" = single device)
     axis_name: str = ""
     # -- distributed modes (SURVEY.md §3.4) ---------------------------
@@ -204,6 +212,9 @@ class GrowState(NamedTuple):
     # IntermediateLeafConstraints' recursive constraint walks
     mono_left: jnp.ndarray
     mono_right: jnp.ndarray
+    # compact-row leaf ids for GOSS histogram-only compaction ([1]
+    # placeholder otherwise): partitioned by the same splits as leaf_id
+    leaf_id_c: jnp.ndarray
 
 
 def _masked_gains(gain, leaf_depth, num_leaves, max_depth):
@@ -228,6 +239,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               node_key: jax.Array = None,
               cegb_pen: jax.Array = None,
               contri: jax.Array = None,
+              compact: Tuple = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -251,6 +263,23 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     Kb = max(1, min(cfg.leaf_batch, L))
     i32 = jnp.int32
     scfg = cfg.split_config
+
+    # GOSS histogram-only compaction (cfg.hist_compact): histograms scan
+    # a COMPACTED buffer of just the sampled rows while the full-row
+    # leaf_id partition/score path stays masked (the split perf.md
+    # proved cheap) — the reference's bag_data_indices_ subset scan,
+    # without its gather. Both partitions run the same split logic; the
+    # compact leaf ids ride the carry alongside the full ones.
+    if not cfg.hist_compact:
+        compact = None
+    if compact is not None:
+        bins_c, bins_t_c, vals_c = compact
+        n_rows_c = bins_c.shape[0]
+        h_bins, h_bins_t, h_vals = bins_c, bins_t_c, vals_c
+    else:
+        bins_c = bins_t_c = vals_c = None
+        n_rows_c = 1
+        h_bins, h_bins_t, h_vals = bins, bins_t, vals
 
     # ---- distributed search modes (SURVEY.md §3.4) -------------------
     mode_feature = bool(cfg.feature_axis)
@@ -285,7 +314,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         return h
 
     if cfg.use_pallas:
-        if bins_t is None:
+        if h_bins_t is None:
             raise ValueError("cfg.use_pallas=True requires bins_t ([F, n] "
                              "feature-major int8 binned matrix)")
         if B > 256:
@@ -293,7 +322,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 f"Pallas histogram path supports at most 256 bins (int8 "
                 f"storage round-trips 0..255); got num_bins={B}. Use the "
                 f"XLA path for wider histograms.")
-        vals_t = vals.T
+        h_vals_t = h_vals.T
         # block size must divide the padded row count; rows_per_block does
         # (padding guarantees it), so cap via gcd to keep the streamed
         # one-hot within scoped VMEM without breaking divisibility.
@@ -302,8 +331,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         # overflows the 16MB scoped-vmem budget at 4096 — those shapes
         # cap at 2048.
         import math
-        r_cap = 4096 if bins_t.shape[0] * B <= 8192 else 2048
-        if bins_t.shape[0] <= 5 and B > 128:
+        r_cap = 4096 if h_bins_t.shape[0] * B <= 8192 else 2048
+        if h_bins_t.shape[0] <= 5 and B > 128:
             # measured on v5e (round 3): at F<=4, B=256 Mosaic's stack
             # allocation for the streamed one-hot blows scoped VMEM
             # (28.7M > 16M) at R=4096; F=6 is fine. Narrow-F shapes are
@@ -313,12 +342,12 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
 
         def hist_multi(leaf_id, small_ids):
             return hist_reduce(multi_leaf_histogram(
-                bins_t, vals_t, leaf_id, small_ids, num_bins=B,
-                rows_per_block=pr))
+                h_bins_t, h_vals_t, leaf_id, small_ids, num_bins=B,
+                rows_per_block=pr, int_mode=cfg.int_hist))
     else:
         def hist_multi(leaf_id, small_ids):
             return hist_reduce(multi_leaf_histogram_xla(
-                bins, vals, leaf_id, small_ids, num_bins=B,
+                h_bins, h_vals, leaf_id, small_ids, num_bins=B,
                 rows_per_block=cfg.rows_per_block,
                 precise=cfg.precise_histogram))
 
@@ -499,11 +528,13 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
 
     # ---- root ----------------------------------------------------------
     leaf_id0 = jnp.zeros(n_rows, dtype=i32)
+    leaf_id0_c = jnp.zeros(n_rows_c, dtype=i32)
     root_small = jnp.concatenate(
         [jnp.zeros(1, i32), jnp.full(Kb - 1, -1, i32)]) if Kb > 1 \
         else jnp.zeros(1, i32)
-    root_hist = hist_multi(leaf_id0, root_small)[0]
-    root_sums = jnp.sum(vals, axis=0)
+    root_hist = hist_multi(leaf_id0_c if compact is not None
+                           else leaf_id0, root_small)[0]
+    root_sums = jnp.sum(h_vals, axis=0)
     if cfg.axis_name:
         root_sums = jax.lax.psum(root_sums, cfg.axis_name)
     if chan_scale is not None:
@@ -581,6 +612,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             (L, L + 1) if use_mono_inter else (1, 1), jnp.bool_),
         mono_right=jnp.zeros(
             (L, L + 1) if use_mono_inter else (1, 1), jnp.bool_),
+        leaf_id_c=(leaf_id0_c if compact is not None
+                   else jnp.zeros(1, i32)),
     )
 
     node_trash = L - 1  # real nodes occupy 0..L-2
@@ -610,8 +643,6 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         # per-leaf attributes packed as a [Kb, 6] matrix: one small MXU
         # matmul replaces every per-row lookup.
         lf = s.leaf_id
-        mask_k = (lf[:, None] == tl_safe[None, :]) & valid[None, :]
-        selected = jnp.any(mask_k, axis=1)
         bfeat_k = s.best_feature[tl_safe]
         attr_cols = [bfeat_k.astype(jnp.float32),
                      s.best_threshold[tl_safe].astype(jnp.float32),
@@ -637,60 +668,76 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 bbundled[bfeat_k].astype(jnp.float32),
                 bdef[bfeat_k].astype(jnp.float32)])
         packed = jnp.stack(attr_cols, axis=1)
-        row_attr = jax.lax.dot_general(
-            mask_k.astype(jnp.float32), packed,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST)       # [n, 6(+1+2W)]
-        feat_r = row_attr[:, 0].astype(i32)
-        thr_r = row_attr[:, 1].astype(i32)
-        dl_r = row_attr[:, 2] > 0.5
-        new_leaf_r = row_attr[:, 3].astype(i32)
-        nb_r = row_attr[:, 4].astype(i32)
-        hn_r = row_attr[:, 5] > 0.5
-        # bins[row, feat_r] without a per-row gather: one-hot over F,
-        # fused compare-select-reduce on the VPU (exact in int32). Under
-        # feature-parallel, only the winning feature's OWNER has the
-        # column — its contribution is broadcast by the psum (every
-        # other device contributes zeros), the TPU-native replacement
-        # for the reference's full-data local split.
-        if cfg.has_bundles:
-            bidx = 6 + ((1 + 2 * W) if cfg.has_categorical else 0)
-            pcol_r = row_attr[:, bidx].astype(i32)
-            start_r = row_attr[:, bidx + 1].astype(i32)
-            bundled_r = row_attr[:, bidx + 2] > 0.5
-            def_r = row_attr[:, bidx + 3].astype(i32)
-        else:
-            pcol_r = feat_r
-        col_ids = jnp.arange(F, dtype=i32)
-        if mode_feature:
-            col_ids = col_ids + off
-        oh_f = pcol_r[:, None] == col_ids[None, :]
-        col = jnp.sum(jnp.where(oh_f, bins.astype(i32), 0), axis=1)
-        if mode_feature:
-            col = jax.lax.psum(col, cfg.feature_axis)
-        if cfg.has_bundles:
-            # invert the bundle relabeling: phys v -> logical bin
-            # (the member's default bin was skipped in the enumeration)
-            idx = col - start_r
-            in_r = (idx >= 0) & (idx <= nb_r - 2)
-            b_log = idx + (idx >= def_r).astype(i32)
-            col = jnp.where(bundled_r,
-                            jnp.where(in_r, b_log, def_r), col)
-        is_missing = hn_r & (col == nb_r - 1)
-        goes_left = jnp.where(is_missing, dl_r, col <= thr_r)
-        if cfg.has_categorical:
-            is_cat_r = row_attr[:, 6] > 0.5
-            oh_w = ((col >> 5)[:, None]
-                    == jnp.arange(W, dtype=i32)[None, :])     # [n, W]
-            lo16 = jnp.sum(jnp.where(oh_w, row_attr[:, 7:7 + W], 0.0),
-                           axis=1).astype(jnp.uint32)
-            hi16 = jnp.sum(jnp.where(oh_w, row_attr[:, 7 + W:7 + 2 * W],
-                                     0.0), axis=1).astype(jnp.uint32)
-            word = lo16 | (hi16 << jnp.uint32(16))
-            cat_left = ((word >> (col & 31).astype(jnp.uint32))
-                        & jnp.uint32(1)) > 0
-            goes_left = jnp.where(is_cat_r, cat_left, goes_left)
-        leaf_id = jnp.where(selected & ~goes_left, new_leaf_r, lf)
+
+        def apply_splits(lf_vec, bins_mat):
+            """Route one row set through this round's selected splits
+            (shared by the full partition and, under hist_compact, the
+            compacted buffer's partition)."""
+            mk = (lf_vec[:, None] == tl_safe[None, :]) & valid[None, :]
+            sel_rows = jnp.any(mk, axis=1)
+            row_attr = jax.lax.dot_general(
+                mk.astype(jnp.float32), packed,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST)  # [n, 6(+1+2W)]
+            feat_r = row_attr[:, 0].astype(i32)
+            thr_r = row_attr[:, 1].astype(i32)
+            dl_r = row_attr[:, 2] > 0.5
+            new_leaf_r = row_attr[:, 3].astype(i32)
+            nb_r = row_attr[:, 4].astype(i32)
+            hn_r = row_attr[:, 5] > 0.5
+            # bins[row, feat_r] without a per-row gather: one-hot over
+            # F, fused compare-select-reduce on the VPU (exact in
+            # int32). Under feature-parallel, only the winning
+            # feature's OWNER has the column — its contribution is
+            # broadcast by the psum (every other device contributes
+            # zeros), the TPU-native replacement for the reference's
+            # full-data local split.
+            if cfg.has_bundles:
+                bidx = 6 + ((1 + 2 * W) if cfg.has_categorical else 0)
+                pcol_r = row_attr[:, bidx].astype(i32)
+                start_r = row_attr[:, bidx + 1].astype(i32)
+                bundled_r = row_attr[:, bidx + 2] > 0.5
+                def_r = row_attr[:, bidx + 3].astype(i32)
+            else:
+                pcol_r = feat_r
+            col_ids = jnp.arange(F, dtype=i32)
+            if mode_feature:
+                col_ids = col_ids + off
+            oh_f = pcol_r[:, None] == col_ids[None, :]
+            col = jnp.sum(jnp.where(oh_f, bins_mat.astype(i32), 0),
+                          axis=1)
+            if mode_feature:
+                col = jax.lax.psum(col, cfg.feature_axis)
+            if cfg.has_bundles:
+                # invert the bundle relabeling: phys v -> logical bin
+                # (the member's default bin was skipped in the
+                # enumeration)
+                idx = col - start_r
+                in_r = (idx >= 0) & (idx <= nb_r - 2)
+                b_log = idx + (idx >= def_r).astype(i32)
+                col = jnp.where(bundled_r,
+                                jnp.where(in_r, b_log, def_r), col)
+            is_missing = hn_r & (col == nb_r - 1)
+            goes_left = jnp.where(is_missing, dl_r, col <= thr_r)
+            if cfg.has_categorical:
+                is_cat_r = row_attr[:, 6] > 0.5
+                oh_w = ((col >> 5)[:, None]
+                        == jnp.arange(W, dtype=i32)[None, :])  # [n, W]
+                lo16 = jnp.sum(jnp.where(oh_w, row_attr[:, 7:7 + W],
+                                         0.0), axis=1).astype(jnp.uint32)
+                hi16 = jnp.sum(
+                    jnp.where(oh_w, row_attr[:, 7 + W:7 + 2 * W], 0.0),
+                    axis=1).astype(jnp.uint32)
+                word = lo16 | (hi16 << jnp.uint32(16))
+                cat_left = ((word >> (col & 31).astype(jnp.uint32))
+                            & jnp.uint32(1)) > 0
+                goes_left = jnp.where(is_cat_r, cat_left, goes_left)
+            return jnp.where(sel_rows & ~goes_left, new_leaf_r, lf_vec)
+
+        leaf_id = apply_splits(lf, bins)
+        leaf_id_c = (apply_splits(s.leaf_id_c, bins_c)
+                     if compact is not None else s.leaf_id_c)
+        hist_lid = leaf_id_c if compact is not None else leaf_id
 
         lsums = s.best_left_sums[tl_safe]      # [Kb, 3]
         rsums = s.best_right_sums[tl_safe]
@@ -703,7 +750,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             both_ids = jnp.concatenate([
                 jnp.where(valid, top_leaf, -1),
                 jnp.where(valid, new_ids, -1)]).astype(i32)
-            hist2 = hist_multi(leaf_id, both_ids)    # [2Kb, F, B, 3]
+            hist2 = hist_multi(hist_lid, both_ids)   # [2Kb, F, B, 3]
             left_hist, right_hist = hist2[:Kb], hist2[Kb:]
             leaf_hist = s.leaf_hist
         else:
@@ -712,7 +759,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             small_ids = jnp.where(
                 valid, jnp.where(left_smaller, top_leaf, new_ids),
                 -1).astype(i32)
-            hist_small = hist_multi(leaf_id, small_ids)  # [Kb, F, B, 3]
+            hist_small = hist_multi(hist_lid, small_ids)  # [Kb, F, B, 3]
             # TPU note: the [L+1, F, B, 3] pool gather/scatter by leaf id
             # lowers to serialized dynamic slices (~13 ms/round at
             # nl=127); both become one-hot matmuls on the MXU instead.
@@ -963,6 +1010,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                        if cfg.has_interaction else s.leaf_used),
             mono_left=ml,
             mono_right=mr,
+            leaf_id_c=leaf_id_c,
         )
         next_gains = _masked_gains(new.best_gain, new.leaf_depth,
                                    new.num_leaves, cfg.max_depth)

@@ -33,7 +33,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _hist_kernel(bins_ref, vals_ref, leaf_ref, small_ref, out_ref, *,
-                 num_bins: int, n_feat: int, n_leaves: int, n_chan: int):
+                 num_bins: int, n_feat: int, n_leaves: int, n_chan: int,
+                 int_mode: bool = False):
     i = pl.program_id(1)      # row-block index (feature block is dim 0)
     # bins stored int8 to halve HBM traffic; wrapped values are restored
     # with & 0xFF after widening (cheap at [F, R])
@@ -43,19 +44,26 @@ def _hist_kernel(bins_ref, vals_ref, leaf_ref, small_ref, out_ref, *,
     small = small_ref[...]                               # [K, 1]
 
     mask = (lid == small).astype(jnp.float32)            # [K, R]
-    rhs = (mask[:, None, :] * vals_blk[None, :, :]) \
-        .reshape(n_leaves * n_chan, -1).astype(jnp.bfloat16)
+    prod = (mask[:, None, :] * vals_blk[None, :, :]) \
+        .reshape(n_leaves * n_chan, -1)
+    # int_mode (use_quantized_grad): grad/hess are small integer levels,
+    # so the contraction rides the MXU's 2x-rate int8 path with EXACT
+    # int32 accumulation (the reference's integer-histogram design,
+    # cuda_gradient_discretizer.cu; measured 1.25x/scan on v5e)
+    rhs = prod.astype(jnp.int8 if int_mode else jnp.bfloat16)
 
     # [B*F, R] one-hot in tiled layout (pltpu.repeat tiles the F rows B
     # times: row q corresponds to (b = q // F, f = q % F))
     big = pltpu.repeat(bins_blk, num_bins, axis=0)
     iota_b = (jax.lax.broadcasted_iota(jnp.int32, (n_feat * num_bins, 1),
                                        0) // n_feat)
-    onehot = (big == iota_b).astype(jnp.bfloat16)
+    onehot = (big == iota_b).astype(jnp.int8 if int_mode
+                                    else jnp.bfloat16)
 
     contrib = jax.lax.dot_general(
         onehot, rhs, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)              # [B*F, K*C]
+        preferred_element_type=(jnp.int32 if int_mode
+                                else jnp.float32))       # [B*F, K*C]
 
     @pl.when(i == 0)
     def _():
@@ -67,11 +75,13 @@ def _hist_kernel(bins_ref, vals_ref, leaf_ref, small_ref, out_ref, *,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_bins", "rows_per_block"))
+                   static_argnames=("num_bins", "rows_per_block",
+                                    "int_mode"))
 def multi_leaf_histogram(bins_t: jax.Array, vals_t: jax.Array,
                          leaf_id: jax.Array, small_ids: jax.Array, *,
                          num_bins: int,
-                         rows_per_block: int = 2048) -> jax.Array:
+                         rows_per_block: int = 2048,
+                         int_mode: bool = False) -> jax.Array:
     """Histograms of K leaves in one fused scan (TPU Pallas path).
 
     Args:
@@ -111,7 +121,8 @@ def multi_leaf_histogram(bins_t: jax.Array, vals_t: jax.Array,
             [bins_t, jnp.zeros((F_pad - F, n), bins_t.dtype)])
 
     kernel = functools.partial(_hist_kernel, num_bins=num_bins,
-                               n_feat=F_blk, n_leaves=K, n_chan=C)
+                               n_feat=F_blk, n_leaves=K, n_chan=C,
+                               int_mode=int_mode)
     out = pl.pallas_call(
         kernel,
         grid=(n_fb, n // R),
@@ -129,12 +140,15 @@ def multi_leaf_histogram(bins_t: jax.Array, vals_t: jax.Array,
                                lambda j, i: (j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((num_bins * F_pad, K * C),
-                                       jnp.float32),
+                                       jnp.int32 if int_mode
+                                       else jnp.float32),
         cost_estimate=pl.CostEstimate(
             flops=2 * F_pad * num_bins * n * K * C,
             bytes_accessed=bins_t.size + vals_t.size * 4 + leaf_id.size * 4,
             transcendentals=0),
     )(bins_t, vals_t, leaf_id.reshape(1, n), small_ids.reshape(K, 1))
+    if int_mode:
+        out = out.astype(jnp.float32)
     # per block j, row q = b * F_blk + f_local
     out = out.reshape(n_fb, num_bins, F_blk, K, C)
     out = out.transpose(3, 0, 2, 1, 4).reshape(K, F_pad, num_bins, C)
