@@ -66,6 +66,8 @@ def main():
                     default=None)
     ap.add_argument("--quant", action="store_true")
     ap.add_argument("--goss", action="store_true")
+    ap.add_argument("--precise", action="store_true",
+                    help="tpu_double_precision_hist (f32 histograms)")
     args = ap.parse_args()
 
     import lightgbm_tpu as lgb
@@ -88,6 +90,8 @@ def main():
         params["use_quantized_grad"] = True
     if args.goss:
         params["data_sample_strategy"] = "goss"
+    if args.precise:
+        params["tpu_double_precision_hist"] = True
     cfg = Config(params)
     eng = GBDT(cfg, ds)
     bin_time = time.time() - t_bin

@@ -368,8 +368,12 @@ class GBDT:
 
         # The fused Pallas kernel needs a TPU backend and int8-roundtrip
         # bin ids (B <= 256); anything else takes the XLA einsum path.
+        # tpu_double_precision_hist also routes to the XLA path — the
+        # Pallas kernel's operands are bf16 by design (quantized mode is
+        # the exact-at-speed alternative).
         self.use_pallas = bool(config.tpu_use_pallas and F > 0
                                and self.B <= 256
+                               and not config.tpu_double_precision_hist
                                and jax.default_backend() == "tpu")
         self.data = _DeviceData(self.train_set, rows_per_block, self.mesh,
                                 transposed=self.use_pallas,

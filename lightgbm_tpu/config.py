@@ -510,6 +510,17 @@ class Config:
             if int(m) not in (-1, 0, 1):
                 log.fatal("monotone_constraints must be -1, 0 or 1, "
                           f"got {m}")
+        tms = str(self.tpu_mesh_shape).strip()
+        if tms:
+            try:
+                nd = int(tms)
+            except ValueError:
+                log.fatal(f"tpu_mesh_shape must be a device count, got "
+                          f"{tms!r} (N-d mesh shapes like '2x4' are not "
+                          f"supported yet)")
+            else:
+                if nd < 1:
+                    log.fatal(f"tpu_mesh_shape must be >= 1, got {nd}")
         mcm = str(self.monotone_constraints_method).lower()
         if mcm not in ("basic", "intermediate", "advanced"):
             log.fatal(f"Unknown monotone_constraints_method {mcm!r}")

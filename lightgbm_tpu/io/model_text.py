@@ -587,8 +587,12 @@ def _parse_tree_block(block: str) -> (Tree, np.ndarray):
         for lf in range(num_leaves):
             c = int(counts[lf])
             if c == 0:
+                # a linear-tree leaf with no features still outputs
+                # leaf_const (tree.h Tree::Predict: the coefficient
+                # loop is empty so nan_found never trips), NOT
+                # leaf_value — pinned by tests/test_model_fixture.py
                 t.leaf_features.append([])
-                t.leaf_coeff.append(None)
+                t.leaf_coeff.append(np.array([consts[lf]]))
             else:
                 t.leaf_features.append(
                     [int(f) for f in feats_flat[off:off + c]])

@@ -106,8 +106,13 @@ def fit_linear_leaves(tree, leaf_id: np.ndarray, X_used: np.ndarray,
 
 def predict_linear(tree, X_used: np.ndarray,
                    leaf: np.ndarray) -> np.ndarray:
-    """Leaf outputs with linear models applied (constant fallback for
-    leaves without a model or rows with non-finite features)."""
+    """Leaf outputs with linear models applied. A row whose linear-leaf
+    features contain a non-finite value falls back to the CONSTANT
+    leaf_value (tree.h Tree::Predict sets nan_found and returns
+    LeafOutput); leaves whose model has no features always output
+    leaf_const (the coefficient loop is empty, so nan_found never
+    trips) — both pinned by tests/test_model_fixture.py. Leaves without
+    a model at all (coeff None, degenerate fit) use leaf_value."""
     out = np.asarray(tree.leaf_value, dtype=np.float64)[leaf]
     if not getattr(tree, "is_linear", False):
         return out
@@ -117,8 +122,9 @@ def predict_linear(tree, X_used: np.ndarray,
         rows = np.flatnonzero(leaf == lf)
         if not len(rows):
             continue
-        A = X_used[np.ix_(rows, tree.leaf_features[lf])]
+        A = X_used[np.ix_(rows, tree.leaf_features[lf])] \
+            if len(tree.leaf_features[lf]) else \
+            np.zeros((len(rows), 0))
         ok = np.isfinite(A).all(axis=1)
-        pred = A[ok] @ beta[:-1] + beta[-1]
-        out[rows[ok]] = pred
+        out[rows[ok]] = A[ok] @ beta[:-1] + beta[-1]
     return out

@@ -1,0 +1,128 @@
+"""Hand-authored reference-format model fixture (VERDICT r2 weak #8).
+
+The round-trip tests elsewhere only prove writer==reader; this fixture
+pins the LightGBM v4 text FORMAT itself, independent of the writer's
+own conventions: a numerical split with NaN default-left
+(decision_type = 2|8), a categorical bitset split (decision_type = 1,
+cat_boundaries/cat_threshold indexing), and a linear-leaf tree
+(is_linear, leaf_const/num_features/leaf_features/leaf_coeff flattened
+layout) — predictions asserted against hand-computed expectations."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+FIXTURE = """tree
+version=v4
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=regression
+feature_names=f0 f1
+feature_infos=[-5:5] 0:1:2:3:5
+tree_sizes=400 450 470
+
+Tree=0
+num_leaves=2
+num_cat=0
+split_feature=0
+split_gain=1
+threshold=0.5
+decision_type=10
+left_child=-1
+right_child=-2
+leaf_value=1.5 -2.5
+leaf_weight=10 10
+leaf_count=10 10
+internal_value=0
+internal_weight=20
+internal_count=20
+is_linear=0
+shrinkage=1
+
+Tree=1
+num_leaves=2
+num_cat=1
+split_feature=1
+split_gain=1
+threshold=0
+decision_type=1
+left_child=-1
+right_child=-2
+leaf_value=10 -20
+leaf_weight=10 10
+leaf_count=10 10
+internal_value=0
+internal_weight=20
+internal_count=20
+cat_boundaries=0 1
+cat_threshold=5
+is_linear=0
+shrinkage=1
+
+Tree=2
+num_leaves=2
+num_cat=0
+split_feature=0
+split_gain=1
+threshold=0.0
+decision_type=0
+left_child=-1
+right_child=-2
+leaf_value=0 0
+leaf_weight=10 10
+leaf_count=10 10
+internal_value=0
+internal_weight=20
+internal_count=20
+is_linear=1
+leaf_const=1.0 -1.0
+num_features=1 0
+leaf_features=0
+leaf_coeff=2.0
+shrinkage=1
+
+end of trees
+
+feature_importances:
+f0=2
+f1=1
+
+parameters:
+[objective: regression]
+end of parameters
+
+pandas_categorical:null
+"""
+
+
+def test_fixture_predictions_hand_computed():
+    bst = lgb.Booster(model_str=FIXTURE)
+    nan = float("nan")
+    X = np.array([
+        [0.0, 0.0],    # t0: 0<=0.5 left 1.5 | t1: cat0 in {0,2} 10
+                       # | t2: left, 1+2*0=1            -> 12.5
+        [1.0, 1.0],    # right -2.5 | cat1 out -20 | right -1 -> -23.5
+        [nan, 2.0],    # NaN default-left 1.5 | cat2 in 10
+                       # | t2 routes NaN->0 (missing_type=none) left,
+                       #   but the LINEAR model sees the raw NaN ->
+                       #   nan_found -> constant leaf_value 0    -> 11.5
+        [2.0, 3.0],    # right -2.5 | cat3 out -20 | right -1  -> -23.5
+        [0.6, nan],    # right -2.5 | NaN cat routes right -20
+                       # | right -1                            -> -23.5
+        [-1.0, 5.0],   # left 1.5 | cat5 out -20
+                       # | left, 1+2*(-1)=-1                   -> -19.5
+    ])
+    expected = np.array([12.5, -23.5, 11.5, -23.5, -23.5, -19.5])
+    pred = bst.predict(X)
+    np.testing.assert_allclose(pred, expected, rtol=0, atol=1e-9)
+
+
+def test_fixture_survives_roundtrip():
+    """Loading the fixture and re-saving must preserve predictions (the
+    writer must not corrupt structures it did not author)."""
+    bst = lgb.Booster(model_str=FIXTURE)
+    re_bst = lgb.Booster(model_str=bst.model_to_string())
+    X = np.array([[0.0, 0.0], [1.0, 1.0], [2.0, 3.0], [-1.0, 5.0]])
+    np.testing.assert_allclose(re_bst.predict(X), bst.predict(X),
+                               rtol=0, atol=1e-9)
