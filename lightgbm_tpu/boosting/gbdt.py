@@ -927,13 +927,24 @@ class GBDT:
         # differ only in accumulation order (exact in quantized mode).
         renews_obj = (type(obj).renew_tree_output
                       is not Objective.renew_tree_output)
+        # the sampled rows ride ONE multi-operand lax.sort; the TPU
+        # compiler's sort lowering is superlinear in operand count
+        # (measured round 3: 11 operands ~67 s compile, two such sorts
+        # 204 s, F=200's 55 operands never finished), so compaction is
+        # gated to shapes whose packed payload fits one small sort —
+        # wider datasets keep the masked GOSS path
+        _F_sort = len(self.train_set.used_features)
+        _per_w = 4 if self.train_set.binned.dtype.itemsize == 1 else 2
+        _n_sort_ops = (1 + (_F_sort + _per_w - 1) // _per_w
+                       + 2 * self.num_class + 2)
         use_goss_compact = (bool(self.config.tpu_goss_compact)
                            and self.config.data_sample_strategy == "goss"
                            and mesh is None and not self.has_bundles
                            and not self.linear_tree and not renews_obj
                            and not (use_quant and renew_quant)
                            and not getattr(obj, "has_pos_state", False)
-                           and top_rate + other_rate < 1.0)
+                           and top_rate + other_rate < 1.0
+                           and _n_sort_ops <= 13)
         self._use_goss_compact = use_goss_compact
         if use_goss_compact:
             from ..ops.histogram import pad_rows as _pad_rows
@@ -976,23 +987,40 @@ class GBDT:
                             word = word | (bins[:, f].astype(jnp.uint32)
                                            << (lane_bits * j))
                     b32.append(word)
-                ops = ([skey] + b32
-                       + [g2[:, k] for k in range(K)]
-                       + [h2[:, k] for k in range(K)]
-                       + [mask_gh, mask_count])
-                sorted_ops = jax.lax.sort(ops, num_keys=1,
-                                          is_stable=False)
-                cut = [o[:n_sub] for o in sorted_ops]
-                lane = cut[0] < n_full
+                payloads = (b32
+                            + [g2[:, k] for k in range(K)]
+                            + [h2[:, k] for k in range(K)]
+                            + [mask_gh, mask_count])
+                # XLA's multi-operand sort compiles superlinearly in operand
+                # count (33 operands took >25 min at F=28 in round 2;
+                # F=200 would be ~55): split the payload into bounded
+                # groups, each sorted with the SAME key. skey is unique
+                # per row, so every group sees the identical permutation
+                # one group under the _n_sort_ops <= 13 eligibility
+                # gate — the grouping loop exists only as structure for
+                # a future cheaper compaction primitive (multiple sorts
+                # COMPOUND compile cost, see docs/perf.md)
+                GROUP = 12
+                cut = [None] * len(payloads)
+                key_cut = None
+                for s0 in range(0, len(payloads), GROUP):
+                    grp = payloads[s0:s0 + GROUP]
+                    so = jax.lax.sort([skey] + grp, num_keys=1,
+                                      is_stable=False)
+                    if key_cut is None:
+                        key_cut = so[0][:n_sub]
+                    for j, arr in enumerate(so[1:]):
+                        cut[s0 + j] = arr[:n_sub]
+                lane = key_cut < n_full
                 cols = []
                 lane_mask = jnp.uint32((1 << lane_bits) - 1)
                 for f in range(Fb):
                     w, j = divmod(f, per_w)
-                    cols.append(((cut[1 + w] >> (lane_bits * j))
+                    cols.append(((cut[w] >> (lane_bits * j))
                                  & lane_mask).astype(bins.dtype))
                 bins_c = jnp.stack(cols, axis=1)
-                g_c = jnp.stack(cut[1 + F4:1 + F4 + K], axis=1)
-                h_c = jnp.stack(cut[1 + F4 + K:1 + F4 + 2 * K], axis=1)
+                g_c = jnp.stack(cut[F4:F4 + K], axis=1)
+                h_c = jnp.stack(cut[F4 + K:F4 + 2 * K], axis=1)
                 mgh_c = jnp.where(lane, cut[-2], 0.0)
                 mc_c = jnp.where(lane, cut[-1], 0.0)
                 bins_t_c = (bins_c.astype(jnp.int8).T

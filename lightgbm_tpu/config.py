@@ -244,6 +244,8 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "tpu_rows_per_block": _P("int", 4096),
     "tpu_mesh_shape": _P("str", ""),
     "tpu_double_precision_hist": _P("bool", False),
+    # rows per streamed chunk for two_round out-of-core file loading
+    "tpu_stream_chunk_rows": _P("int", 500000, [], (1000, None)),
     # leaves expanded per growth round; 1 = exact reference leaf-wise
     # order, larger batches fuse K leaf histograms into one data scan
     "tpu_leaf_batch": _P("int", 32, [], (1, 256)),
@@ -314,7 +316,6 @@ _FALSE_STRINGS = {"false", "0", "f", "no", "n", "-", "off"}
 # references cover the whole _PARAMS table). name -> what's missing.
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "forcedsplits_filename": "forced split structures are not applied",
-    "forcedbins_filename": "forced bin boundaries are not applied",
     "cegb_penalty_feature_lazy":
         "per-row feature-acquisition tracking; use "
         "cegb_penalty_feature_coupled",
@@ -343,8 +344,6 @@ DISSOLVED_PARAMS: Dict[str, str] = {
                           "(pre-dropping features that cannot satisfy "
                           "min_data_in_leaf); the split search enforces "
                           "min_data_in_leaf exactly",
-    "two_round": "an upstream memory-saving load strategy; binning "
-                 "already samples via bin_construct_sample_cnt",
     "precise_float_parser": "numpy's float parser is already "
                             "round-trip precise",
     "pre_partition": "row sharding is derived from the mesh, not "

@@ -128,15 +128,55 @@ class BinMapper:
                     min_data_in_bin: int = 3, use_missing: bool = True,
                     zero_as_missing: bool = False,
                     is_categorical: bool = False,
-                    min_data_in_cat: int = 1) -> "BinMapper":
-        """Build a mapper from sampled raw values (NaN included)."""
+                    min_data_in_cat: int = 1,
+                    forced_bounds=None) -> "BinMapper":
+        """Build a mapper from sampled raw values (NaN included).
+        ``forced_bounds``: user-forced bin upper bounds
+        (forcedbins_filename, DatasetLoader FindBinWithPredefinedBin —
+        UNVERIFIED): the listed boundaries are guaranteed present; the
+        remaining bin budget is filled by the usual greedy packing."""
         values = np.asarray(values, dtype=np.float64)
         if is_categorical:
             return BinMapper._categorical_from_sample(
                 values, max_bin, use_missing)
-        return BinMapper._numerical_from_sample(
+        m = BinMapper._numerical_from_sample(
             values, total_sample_cnt, max_bin, min_data_in_bin, use_missing,
             zero_as_missing)
+        if forced_bounds is not None and len(forced_bounds):
+            forced = np.asarray(sorted(set(float(b)
+                                           for b in forced_bounds)))
+            ub = np.asarray(m.bin_upper_bound)
+            cap = max_bin - (1 if m.missing_type == MISSING_NAN else 0)
+            if len(forced) + 1 > cap:
+                # +inf terminator always occupies one slot; forced
+                # bounds beyond the budget are dropped (highest first)
+                # so num_bin can never exceed max_bin
+                log.warning(
+                    f"forcedbins: {len(forced)} forced bounds exceed "
+                    f"the max_bin={max_bin} budget; keeping the first "
+                    f"{cap - 1}")
+                forced = forced[:cap - 1]
+            merged = np.array(sorted(set(ub) | set(forced)))
+            if len(merged) > cap:
+                # over budget: drop the greedy (non-forced) bounds
+                # nearest to a forced one until the cap holds
+                keep_forced = np.isin(merged, forced) | np.isinf(merged)
+                greedy = merged[~keep_forced]
+                n_drop = len(merged) - cap
+                if n_drop > 0 and len(greedy):
+                    dist = np.min(np.abs(greedy[:, None]
+                                         - forced[None, :]), axis=1)
+                    drop = set(greedy[np.argsort(dist)[:n_drop]])
+                    merged = np.array([b for b in merged
+                                       if b not in drop])
+            if merged[-1] != np.inf:
+                merged = np.append(merged, np.inf)
+            m.bin_upper_bound = merged
+            m.num_bin = len(merged) + (1 if m.missing_type == MISSING_NAN
+                                       else 0)
+            m.default_bin = int(np.searchsorted(merged, 0.0,
+                                                side="left"))
+        return m
 
     @staticmethod
     def _numerical_from_sample(values, total_sample_cnt, max_bin,
@@ -295,7 +335,9 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
                      zero_as_missing: bool = False,
                      categorical_features: Optional[List[int]] = None,
                      max_bin_by_feature: Optional[List[int]] = None,
-                     seed: int = 1) -> List[BinMapper]:
+                     seed: int = 1,
+                     forced_bins: Optional[Dict[int, List[float]]] = None
+                     ) -> List[BinMapper]:
     """Build a BinMapper per column of ``X`` from a row sample.
 
     Mirrors DatasetLoader::ConstructFromSampleData's sampling step
@@ -327,5 +369,20 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
             col = np.asarray(col.todense(), dtype=np.float64).ravel()
         mappers.append(BinMapper.from_sample(
             col, n_sample, mb, min_data_in_bin, use_missing,
-            zero_as_missing, is_categorical=(f in categorical)))
+            zero_as_missing, is_categorical=(f in categorical),
+            forced_bounds=(forced_bins or {}).get(f)))
     return mappers
+
+
+def load_forced_bins(path: str) -> Dict[int, List[float]]:
+    """Parse a forcedbins_filename JSON file: a list of
+    ``{"feature": i, "bin_upper_bound": [...]}`` entries (upstream
+    docs/Advanced-Topics forced-bins format)."""
+    import json
+    with open(path) as f:
+        spec = json.load(f)
+    out: Dict[int, List[float]] = {}
+    for entry in spec:
+        out[int(entry["feature"])] = [
+            float(v) for v in entry["bin_upper_bound"]]
+    return out

@@ -19,7 +19,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ..utils import log
-from .binning import BIN_TYPE_CATEGORICAL, BinMapper, find_bin_mappers
+from .binning import (BIN_TYPE_CATEGORICAL, BinMapper, find_bin_mappers,
+                      load_forced_bins)
 
 
 def _host_mem_bytes():
@@ -183,6 +184,8 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
+        if getattr(self, "_stream_path", None):
+            return self._construct_streamed()
         if self._finish_pushed():
             return self
         # scipy sparse binning never densifies the raw matrix (8 bytes x
@@ -224,7 +227,10 @@ class Dataset:
                                                   False)),
                 categorical_features=cat_idx,
                 max_bin_by_feature=p.get("max_bin_by_feature"),
-                seed=int(p.get("data_random_seed", 1)))
+                seed=int(p.get("data_random_seed", 1)),
+                forced_bins=(load_forced_bins(
+                    str(p["forcedbins_filename"]))
+                    if p.get("forcedbins_filename") else None))
             self.used_features = [i for i, m in enumerate(self.bin_mappers)
                                   if not m.is_trivial]
             if len(self.used_features) < self.num_total_features:
@@ -234,24 +240,7 @@ class Dataset:
                 log.warning("There are no meaningful features which satisfy "
                             "the provided configuration.")
 
-        max_num_bin = max((self.bin_mappers[f].num_bin
-                           for f in self.used_features), default=2)
-        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
-        # capacity guard: fail with a clear message BEFORE allocating a
-        # binned matrix that cannot fit host RAM (the reference streams
-        # via pipeline_reader/two_round; out-of-core ingestion is not
-        # implemented here — SURVEY.md §7.4)
-        est = (int(self.num_data) * max(len(self.used_features), 1)
-               * np.dtype(dtype).itemsize)
-        budget = _host_mem_bytes()
-        if budget is not None and est > 0.9 * budget:
-            log.fatal(
-                f"binned dataset ({self.num_data} rows x "
-                f"{len(self.used_features)} features) would need "
-                f"{est / 2**30:.1f} GiB — more than 90% of host RAM "
-                f"({budget / 2**30:.1f} GiB). Reduce rows/features, "
-                f"lower max_bin to fit uint8, or shard rows across "
-                f"hosts (parallel/multihost.py)")
+        dtype = self._binned_dtype_with_guard()
         cols = []
         for f in self.used_features:
             if is_sparse:
@@ -273,6 +262,170 @@ class Dataset:
         self._constructed = True
         if self.free_raw_data:
             self.data = None
+        return self
+
+    # ------------------------------------------------------------------
+    def _binned_dtype_with_guard(self):
+        """Bin-id dtype for the packed matrix + the host-RAM capacity
+        guard: fail with a clear message BEFORE allocating a binned
+        matrix that cannot fit (file input can stream out-of-core via
+        two_round=true, but the BINNED matrix itself must fit)."""
+        max_num_bin = max((self.bin_mappers[f].num_bin
+                           for f in self.used_features), default=2)
+        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+        est = (int(self.num_data) * max(len(self.used_features), 1)
+               * np.dtype(dtype).itemsize)
+        budget = _host_mem_bytes()
+        if budget is not None and est > 0.9 * budget:
+            log.fatal(
+                f"binned dataset ({self.num_data} rows x "
+                f"{len(self.used_features)} features) would need "
+                f"{est / 2**30:.1f} GiB — more than 90% of host RAM "
+                f"({budget / 2**30:.1f} GiB). Reduce rows/features, "
+                f"lower max_bin to fit uint8, or shard rows across "
+                f"hosts (parallel/multihost.py)")
+        return dtype
+
+    def _construct_streamed(self) -> "Dataset":
+        """Two-round out-of-core load (dataset_loader.cpp two-round path
+        + utils/pipeline_reader.h, UNVERIFIED — empty mount): round 1
+        streams the file to draw a uniform row sample (bottom-k keys =
+        sampling without replacement) and collect the small metadata
+        columns; round 2 streams again, binning each chunk directly into
+        the preallocated packed matrix. Peak memory is the BINNED matrix
+        (1-2 bytes/cell) + one raw chunk — never the n x F float64 raw
+        matrix."""
+        from ..config import coerce_bool
+        from .text_loader import iter_text_chunks
+        p = self.params
+        sp = self._stream_cols
+        if coerce_bool(p.get("linear_tree", False)):
+            log.fatal("two_round streaming cannot keep the raw feature "
+                      "matrix linear_tree needs; load in one round")
+        chunk_rows = int(p.get("tpu_stream_chunk_rows", 500_000))
+        cap = int(p.get("bin_construct_sample_cnt", 200000))
+        rng = np.random.default_rng(int(p.get("data_random_seed", 1)))
+
+        def chunks():
+            return iter_text_chunks(
+                self._stream_path, chunk_rows=chunk_rows,
+                label_column=sp.get("label_column", "auto"),
+                weight_column=sp.get("weight_column"),
+                group_column=sp.get("group_column"),
+                ignore_column=sp.get("ignore_column"),
+                has_header=(coerce_bool(sp["header"]) if "header" in sp
+                            else None))
+
+        # ---- round 1: sample + metadata (a valid set built against a
+        # reference skips the sample pool and adopts the reference's
+        # mappers, mirroring the one-round path) -----------------------
+        use_ref = self.reference is not None
+        pool_X = pool_keys = None
+        labels, weights, qids = [], [], []
+        n_total = 0
+        feat_names = None
+        for ch in chunks():
+            n_total += len(ch.X)
+            feat_names = ch.feature_names or feat_names
+            if ch.label is not None:
+                labels.append(ch.label)
+            if ch.weight is not None:
+                weights.append(ch.weight)
+            if ch.qid is not None:
+                qids.append(ch.qid)
+            n_feat_seen = ch.X.shape[1]
+            if use_ref:
+                continue
+            keys = rng.random(len(ch.X))
+            if pool_X is None:
+                pool_X, pool_keys = ch.X, keys
+            else:
+                pool_X = np.concatenate([pool_X, ch.X])
+                pool_keys = np.concatenate([pool_keys, keys])
+            if len(pool_keys) > cap:
+                top = np.argpartition(pool_keys, cap)[:cap]
+                pool_X, pool_keys = pool_X[top], pool_keys[top]
+        if n_total == 0:
+            log.fatal(f"Data file {self._stream_path} is empty")
+        self.num_data = n_total
+        self.num_total_features = n_feat_seen
+        if self.metadata.label is None and labels:
+            self.metadata.label = np.concatenate(labels)
+        if self.metadata.weight is None and weights:
+            self.metadata.weight = np.concatenate(weights)
+        if self.metadata.query_boundaries is None and qids:
+            qid = np.concatenate(qids)
+            change = np.flatnonzero(np.diff(qid) != 0) + 1
+            self.metadata.set_group(np.diff(
+                np.concatenate([[0], change, [len(qid)]])))
+        # sidecar files, like the one-round loader (metadata.cpp:
+        # <data>.weight / <data>.query)
+        import os as _os
+        if self.metadata.weight is None \
+                and _os.path.exists(self._stream_path + ".weight"):
+            self.metadata.weight = np.loadtxt(
+                self._stream_path + ".weight", dtype=np.float64).ravel()
+        if self.metadata.query_boundaries is None \
+                and _os.path.exists(self._stream_path + ".query"):
+            self.metadata.set_group(np.loadtxt(
+                self._stream_path + ".query", dtype=np.int64).ravel())
+        self._validate_metadata()
+        if use_ref:
+            ref = self.reference.construct()
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.feature_names = ref.feature_names
+            self.categorical_idx = ref.categorical_idx
+            if self.num_total_features != ref.num_total_features:
+                log.fatal(f"streamed file has {self.num_total_features} "
+                          f"features, reference has "
+                          f"{ref.num_total_features}")
+        else:
+            self.feature_names = (feat_names if feat_names else
+                                  [f"Column_{i}" for i in
+                                   range(self.num_total_features)])
+            cat_idx = self._resolve_categorical(self.feature_names)
+            self.categorical_idx = cat_idx
+            self.bin_mappers = find_bin_mappers(
+                pool_X,
+                max_bin=int(p.get("max_bin", 255)),
+                min_data_in_bin=int(p.get("min_data_in_bin", 3)),
+                sample_cnt=cap,
+                use_missing=coerce_bool(p.get("use_missing", True)),
+                zero_as_missing=coerce_bool(p.get("zero_as_missing",
+                                                  False)),
+                categorical_features=cat_idx,
+                max_bin_by_feature=p.get("max_bin_by_feature"),
+                seed=int(p.get("data_random_seed", 1)),
+                forced_bins=(load_forced_bins(
+                    str(p["forcedbins_filename"]))
+                    if p.get("forcedbins_filename") else None))
+            del pool_X, pool_keys
+            self.used_features = [
+                i for i, m in enumerate(self.bin_mappers)
+                if not m.is_trivial]
+            if not self.used_features:
+                log.warning("There are no meaningful features which "
+                            "satisfy the provided configuration.")
+
+        # ---- round 2: bin chunk-by-chunk into the packed matrix ------
+        dtype = self._binned_dtype_with_guard()
+        self.binned = np.empty((n_total, len(self.used_features)),
+                               dtype=dtype)
+        r0 = 0
+        for ch in chunks():
+            r1 = r0 + len(ch.X)
+            for i, f in enumerate(self.used_features):
+                self.binned[r0:r1, i] = \
+                    self.bin_mappers[f].values_to_bins(ch.X[:, f])
+            r0 = r1
+        if r0 != n_total:
+            log.fatal(f"file changed between streaming rounds: "
+                      f"{r0} rows vs {n_total}")
+        self._constructed = True
+        log.info(f"two_round: streamed {n_total} rows x "
+                 f"{self.num_total_features} features into a "
+                 f"{self.binned.nbytes / 2**20:.0f} MiB binned matrix")
         return self
 
     # ------------------------------------------------------------------
@@ -463,6 +616,13 @@ class Dataset:
         # resolve reference aliases (label=, weight=, group=/query=,
         # has_header=, ignore_feature=...) to canonical names
         p = {Config.canonical_name(k): v for k, v in self.params.items()}
+        if coerce_bool(p.get("two_round", False)):
+            # out-of-core two-round load: defer to construct(), which
+            # streams the file twice (sample pass + binning pass) and
+            # never materializes the raw matrix
+            self._stream_path = path
+            self._stream_cols = p
+            return
         loaded = load_text(
             path,
             label_column=p.get("label_column", "auto"),

@@ -28,6 +28,9 @@ class LoadedText:
     weight: Optional[np.ndarray] = None
     group: Optional[np.ndarray] = None
     feature_names: Optional[List[str]] = None
+    # raw per-row query ids (streamed chunks only — group boundaries
+    # can span chunks, so the consumer derives counts from qids)
+    qid: Optional[np.ndarray] = None
 
 
 def _first_data_lines(path: str, k: int = 2) -> List[str]:
@@ -172,6 +175,30 @@ def _resolve_column(spec, names: Optional[List[str]]) -> Optional[int]:
     return int(s)
 
 
+def _resolve_columns(names, label_column, weight_column, group_column,
+                     ignore_column):
+    """Shared column-spec resolution for the one-round and streamed
+    loaders: returns (label_idx, weight_idx, group_idx, drop_list)."""
+    lbl_idx = _resolve_column(
+        0 if label_column in ("auto", "", None) else label_column, names)
+    w_idx = _resolve_column(weight_column, names)
+    g_idx = _resolve_column(group_column, names)
+    drop = [i for i in (lbl_idx, w_idx, g_idx) if i is not None]
+    if ignore_column:
+        if isinstance(ignore_column, str):
+            s = ignore_column
+            if s.startswith("name:"):
+                # reference form name:c1,c2,c3 — prefix applies to the
+                # whole comma list
+                spec = ["name:" + c for c in s[5:].split(",") if c]
+            else:
+                spec = s.split(",")
+        else:
+            spec = ignore_column
+        drop += [_resolve_column(c, names) for c in spec]
+    return lbl_idx, w_idx, g_idx, drop
+
+
 def load_text(path, label_column="auto", weight_column=None,
               group_column=None, ignore_column=None,
               has_header: Optional[bool] = None) -> LoadedText:
@@ -201,24 +228,9 @@ def load_text(path, label_column="auto", weight_column=None,
                                         n_rows, n_cols)
         if X is None:
             X = _parse_dense_python(path, delim, 1 if header else 0)
-        lbl_idx = (_resolve_column(
-            0 if label_column in ("auto", "", None) else label_column,
-            names))
-        w_idx = _resolve_column(weight_column, names)
-        g_idx = _resolve_column(group_column, names)
-        drop = [i for i in (lbl_idx, w_idx, g_idx) if i is not None]
-        if ignore_column:
-            if isinstance(ignore_column, str):
-                s = ignore_column
-                if s.startswith("name:"):
-                    # reference form name:c1,c2,c3 — prefix applies to
-                    # the whole comma list
-                    spec = ["name:" + c for c in s[5:].split(",") if c]
-                else:
-                    spec = s.split(",")
-            else:
-                spec = ignore_column
-            drop += [_resolve_column(c, names) for c in spec]
+        lbl_idx, w_idx, g_idx, drop = _resolve_columns(
+            names, label_column, weight_column, group_column,
+            ignore_column)
         keep = [i for i in range(X.shape[1]) if i not in drop]
         out = LoadedText(
             X=X[:, keep],
@@ -239,3 +251,48 @@ def load_text(path, label_column="auto", weight_column=None,
     if out.group is None and os.path.exists(path + ".query"):
         out.group = np.loadtxt(path + ".query", dtype=np.int64).ravel()
     return out
+
+
+def _split_chunk_columns(X: np.ndarray, names, lbl_idx, w_idx, g_idx,
+                         drop) -> LoadedText:
+    keep = [i for i in range(X.shape[1]) if i not in drop]
+    return LoadedText(
+        X=X[:, keep],
+        label=X[:, lbl_idx] if lbl_idx is not None else None,
+        weight=X[:, w_idx] if w_idx is not None else None,
+        qid=(X[:, g_idx].astype(np.int64) if g_idx is not None
+             else None),
+        feature_names=([names[i] for i in keep] if names else None))
+
+
+def iter_text_chunks(path, chunk_rows: int = 500_000,
+                     label_column="auto", weight_column=None,
+                     group_column=None, ignore_column=None,
+                     has_header: Optional[bool] = None):
+    """Stream a CSV/TSV file in row chunks (two_round loading — the
+    reference's pipelined reader, utils/pipeline_reader.h +
+    dataset_loader.cpp two-round path, UNVERIFIED): yields LoadedText
+    per chunk WITHOUT ever materializing the full raw matrix. LibSVM
+    files are rejected (use the one-round loader)."""
+    path = os.fspath(path)
+    kind, delim, sniffed_header = sniff_format(path)
+    if kind == "libsvm":
+        log.fatal("two_round streaming supports CSV/TSV files; LibSVM "
+                  "files load in one round (their sparse form is "
+                  "already compact)")
+    header = sniffed_header if has_header is None else has_header
+    names = None
+    if header:
+        names = [t.strip() for t in
+                 _first_data_lines(path, 1)[0].split(delim)]
+    lbl_idx, w_idx, g_idx, drop = _resolve_columns(
+        names, label_column, weight_column, group_column, ignore_column)
+
+    import pandas as pd
+    reader = pd.read_csv(
+        path, sep=delim, header=0 if header else None,
+        chunksize=int(chunk_rows), comment="#",
+        na_values=["na", "nan", "NA", "NaN", "?"], engine="c")
+    for chunk in reader:
+        X = chunk.to_numpy(dtype=np.float64)
+        yield _split_chunk_columns(X, names, lbl_idx, w_idx, g_idx, drop)

@@ -37,3 +37,17 @@ if not TPU_MODE:
     jax.config.update("jax_platforms", "cpu")
     assert jax.device_count() == 8, (
         f"expected 8 fake CPU devices, got {jax.devices()}")
+
+
+def pytest_collection_modifyitems(config, items):
+    if not TPU_MODE or jax.device_count() >= 8:
+        return
+    import pytest
+    skip = pytest.mark.skip(
+        reason="needs the 8-device CPU mesh (TPU mode has "
+               f"{jax.device_count()} device(s))")
+    multi_device_files = {"test_distributed.py",
+                          "test_parallel_learners.py"}
+    for item in items:
+        if item.fspath.basename in multi_device_files:
+            item.add_marker(skip)

@@ -184,6 +184,90 @@ def test_cli_refit_matches_python_refit(tmp_path):
     assert not np.allclose(cli_bst.predict(X), bst.predict(X))
 
 
+def test_two_round_streamed_load_matches_one_round(tmp_path):
+    """two_round=true streams the file twice (sample pass + binning
+    pass) without materializing the raw matrix (dataset_loader.cpp
+    two-round path). Same mappers + binned matrix + model as one-round
+    when the sample covers all rows."""
+    X, y = _data(n=3000)
+    path = str(tmp_path / "train.csv")
+    _write_csv(path, X, y)
+    one = lgb.Dataset(path, params={"max_bin": 63})
+    one.construct()
+    two = lgb.Dataset(path, params={"max_bin": 63, "two_round": True,
+                                    "tpu_stream_chunk_rows": 1000})
+    two.construct()
+    assert two.num_data == one.num_data
+    np.testing.assert_array_equal(two.binned, one.binned)
+    np.testing.assert_array_equal(two.metadata.label, one.metadata.label)
+    b1 = lgb.train({"objective": "binary", "num_leaves": 15,
+                    "verbosity": -1}, one, num_boost_round=5)
+    b2 = lgb.train({"objective": "binary", "num_leaves": 15,
+                    "verbosity": -1}, two, num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_two_round_valid_set_adopts_reference_mappers(tmp_path):
+    """A two_round valid set must bin against the TRAINING mappers
+    (reference), exactly like the one-round path."""
+    X, y = _data(n=2000)
+    tp, vp = str(tmp_path / "t.csv"), str(tmp_path / "v.csv")
+    _write_csv(tp, X[:1500], y[:1500])
+    _write_csv(vp, X[1500:], y[1500:])
+    params = {"two_round": True, "max_bin": 31,
+              "tpu_stream_chunk_rows": 1000}
+    ds = lgb.Dataset(tp, params=dict(params))
+    vs = lgb.Dataset(vp, reference=ds, params=dict(params))
+    vs.construct()
+    ds.construct()
+    for m1, m2 in zip(ds.bin_mappers, vs.bin_mappers):
+        np.testing.assert_array_equal(m1.bin_upper_bound,
+                                      m2.bin_upper_bound)
+    res = {}
+    lgb.train({"objective": "binary", "num_leaves": 15, "metric": "auc",
+               "verbosity": -1}, ds, num_boost_round=8, valid_sets=[vs],
+              callbacks=[lgb.record_evaluation(res)])
+    assert res["valid_0"]["auc"][-1] > 0.85
+
+
+def test_two_round_sidecar_query_file(tmp_path):
+    """two_round must honor <data>.query sidecars like the one-round
+    loader (metadata.cpp)."""
+    rng = np.random.default_rng(5)
+    n_q, per_q = 40, 25
+    X = rng.normal(size=(n_q * per_q, 5))
+    y = np.clip(X[:, 0] + rng.normal(scale=0.5, size=len(X)),
+                0, 3).astype(int).astype(float)
+    p = str(tmp_path / "rank.csv")
+    _write_csv(p, X, y)
+    np.savetxt(p + ".query", np.full(n_q, per_q, dtype=np.int64),
+               fmt="%d")
+    ds = lgb.Dataset(p, params={"two_round": True,
+                                "tpu_stream_chunk_rows": 300})
+    ds.construct()
+    assert ds.metadata.query_boundaries is not None
+    assert len(ds.metadata.query_boundaries) == n_q + 1
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=3)
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_two_round_subsampled_mappers_trains(tmp_path):
+    """When rows exceed the bin sample cap, the streamed sample is a
+    bottom-k uniform draw; the model still trains fine."""
+    X, y = _data(n=4000)
+    path = str(tmp_path / "train.csv")
+    _write_csv(path, X, y)
+    ds = lgb.Dataset(path, params={"two_round": True,
+                                   "bin_construct_sample_cnt": 500,
+                                   "tpu_stream_chunk_rows": 1000})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=8)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.85
+
+
 def test_cli_save_binary(tmp_path):
     from lightgbm_tpu.app import run
     X, y = _data(n=300)

@@ -244,6 +244,39 @@ def test_sparse_predict_without_densify():
                                rtol=1e-7)
 
 
+def test_forcedbins_boundaries_respected(tmp_path):
+    """forcedbins_filename (DatasetLoader predefined-bin path): the
+    listed upper bounds must appear verbatim in the feature's bin
+    boundaries, so split thresholds can land exactly on them."""
+    import json
+    rng = np.random.default_rng(12)
+    X = rng.uniform(0, 1, size=(3000, 3))
+    y = (X[:, 0] > 0.337).astype(float)
+    fb = str(tmp_path / "forced.json")
+    with open(fb, "w") as f:
+        json.dump([{"feature": 0, "bin_upper_bound": [0.337, 0.8]}], f)
+    ds = lgb.Dataset(X, label=y,
+                     params={"forcedbins_filename": fb, "max_bin": 16})
+    ds.construct()
+    ub = ds.bin_mappers[0].bin_upper_bound
+    assert 0.337 in ub and 0.8 in ub, ub
+    assert ds.bin_mappers[0].num_bin <= 16
+    # a model trained on this data can realize the exact threshold
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "forcedbins_filename": fb,
+                     "max_bin": 16}, ds, num_boost_round=3)
+    thresholds = []
+    for info in bst.dump_model()["tree_info"]:
+        def walk(nd):
+            if "threshold" in nd and nd["threshold"] is not None:
+                thresholds.append(nd["threshold"])
+            for c in ("left_child", "right_child"):
+                if isinstance(nd.get(c), dict):
+                    walk(nd[c])
+        walk(info["tree_structure"])
+    assert any(abs(t - 0.337) < 1e-12 for t in thresholds), thresholds
+
+
 def test_unimplemented_param_warns():
     from lightgbm_tpu.config import Config, _WARNED_UNIMPLEMENTED
     from lightgbm_tpu.utils import log
