@@ -366,6 +366,21 @@ class GBDT:
             self._cegb_coupled = arr * float(config.cegb_tradeoff)
             self._cegb_used = np.zeros(self.F_pad, dtype=bool)
 
+        # ---- forced splits (forcedsplits_filename; ForceSplits in
+        # serial_tree_learner.cpp — UNVERIFIED): JSON tree flattened
+        # into a preorder table applied one entry per growth round ----
+        self._forced_dev = None
+        self._n_forced = 0
+        fs_path = str(config.forcedsplits_filename or "").strip()
+        if fs_path:
+            if (self.mesh is not None or config.tpu_hist_mode != "pool"
+                    or self.has_bundles):
+                log.warning("forcedsplits_filename requires the serial "
+                            "learner, tpu_hist_mode=pool and no EFB "
+                            "bundles; ignoring forced splits")
+            else:
+                self._load_forced_splits(fs_path)
+
         # The fused Pallas kernel needs a TPU backend and int8-roundtrip
         # bin ids (B <= 256); anything else takes the XLA einsum path.
         # tpu_double_precision_hist also routes to the XLA path — the
@@ -551,6 +566,60 @@ class GBDT:
         """Per-tree shrinkage; RF overrides to 1.0 (rf.hpp stores raw)."""
         return float(self.config.learning_rate)
 
+    def _load_forced_splits(self, path: str) -> None:
+        """Parse a forcedsplits_filename JSON tree ({"feature",
+        "threshold", nested "left"/"right"}) into the preorder table
+        grow_tree consumes. Entries on unused/categorical features are
+        skipped with their subtrees, like the reference's validity
+        checks."""
+        import json
+        from ..io.binning import BIN_TYPE_CATEGORICAL
+        with open(path) as f:
+            spec = json.load(f)
+        orig_to_used = {f: i for i, f in
+                        enumerate(self.train_set.used_features)}
+        parents, lefts, feats, tbins = [], [], [], []
+
+        def walk(node, parent_idx, is_left):
+            if not isinstance(node, dict) or "feature" not in node:
+                return
+            fo = int(node["feature"])
+            u = orig_to_used.get(fo)
+            mapper = (self.train_set.bin_mappers[fo]
+                      if fo < len(self.train_set.bin_mappers) else None)
+            if u is None or mapper is None:
+                log.warning(f"forced split on unused feature {fo} "
+                            f"skipped (with its subtree)")
+                return
+            if mapper.bin_type == BIN_TYPE_CATEGORICAL:
+                log.warning(f"forced split on categorical feature {fo} "
+                            f"is not supported; skipped (with its "
+                            f"subtree)")
+                return
+            if len(parents) >= self.config.num_leaves - 1:
+                log.warning("more forced splits than num_leaves-1; "
+                            "extra entries ignored")
+                return
+            tb = mapper.value_to_bin(float(node["threshold"]))
+            idx = len(parents)
+            parents.append(parent_idx)
+            lefts.append(bool(is_left))
+            feats.append(u)
+            tbins.append(tb)
+            walk(node.get("left"), idx, True)
+            walk(node.get("right"), idx, False)
+
+        walk(spec, -1, False)
+        if parents:
+            self._n_forced = len(parents)
+            self._forced_dev = (
+                jnp.asarray(np.asarray(parents, np.int32)),
+                jnp.asarray(np.asarray(lefts, bool)),
+                jnp.asarray(np.asarray(feats, np.int32)),
+                jnp.asarray(np.asarray(tbins, np.int32)))
+            log.info(f"applying {self._n_forced} forced split(s) at "
+                     f"the top of every tree")
+
     def _make_grow_cfg(self) -> GrowConfig:
         config = self.config
         _hist_scatter = (self.learner_type == "data"
@@ -612,6 +681,7 @@ class GBDT:
             extra_trees=config.extra_trees,
             extra_seed=config.extra_seed,
             has_contri=self.has_contri,
+            n_forced=self._n_forced,
         )
 
     # ------------------------------------------------------------------
@@ -715,7 +785,8 @@ class GBDT:
                     bundle=self._bundle_dev, chan_scale=chan_scale,
                     node_key=(None if qkey is None
                               else jax.random.fold_in(qkey, 0xB14D + k)),
-                    cegb_pen=cegb_pen, contri=self.feat_contri)
+                    cegb_pen=cegb_pen, contri=self.feat_contri,
+                    forced=self._forced_dev)
                 if use_quant and renew_quant:
                     # re-derive leaf outputs from FULL-precision sums
                     # (quant_train_renew_leaf)
@@ -1047,7 +1118,8 @@ class GBDT:
                         chan_scale=chan_scale,
                         node_key=jax.random.fold_in(qkey, 0xB14D + k),
                         cegb_pen=cegb_pen, contri=self.feat_contri,
-                        compact=(bins_c, bins_t_c, vals_c))
+                        compact=(bins_c, bins_t_c, vals_c),
+                        forced=self._forced_dev)
                     # FULL leaf ids came from the in-loop partition; the
                     # score update is the same one-hot matmul as the
                     # masked path (no per-row traversal)

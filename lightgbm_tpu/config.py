@@ -315,7 +315,6 @@ _FALSE_STRINGS = {"false", "0", "f", "no", "n", "-", "off"}
 # param acts"; tests/test_param_audit.py asserts this table + source
 # references cover the whole _PARAMS table). name -> what's missing.
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
-    "forcedsplits_filename": "forced split structures are not applied",
     "cegb_penalty_feature_lazy":
         "per-row feature-acquisition tracking; use "
         "cegb_penalty_feature_coupled",

@@ -70,6 +70,13 @@ class GrowConfig:
     # sampled-row buffer (grow_tree's `compact` argument) while the
     # full-row partition/score path stays masked
     hist_compact: bool = False
+    # forced splits (forcedsplits_filename): number of entries in the
+    # PREORDER-flattened forced-split table (grow_tree's `forced`
+    # argument; parents must precede children — the target-slot
+    # resolution depends on it); the first n_forced growth rounds
+    # apply them one per round, engine-gated to the serial pool-mode
+    # learner
+    n_forced: int = 0
     # mesh axis for data-parallel histogram reduction ("" = single device)
     axis_name: str = ""
     # -- distributed modes (SURVEY.md §3.4) ---------------------------
@@ -215,6 +222,11 @@ class GrowState(NamedTuple):
     # compact-row leaf ids for GOSS histogram-only compaction ([1]
     # placeholder otherwise): partitioned by the same splits as leaf_id
     leaf_id_c: jnp.ndarray
+    # forced-split machinery (placeholders when cfg.n_forced == 0):
+    # next forced entry to attempt, and each entry's realized target
+    # leaf slot (-1 pending parent, -2 cancelled by a skipped parent)
+    forced_ptr: jnp.ndarray
+    forced_target: jnp.ndarray
 
 
 def _masked_gains(gain, leaf_depth, num_leaves, max_depth):
@@ -240,6 +252,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               cegb_pen: jax.Array = None,
               contri: jax.Array = None,
               compact: Tuple = None,
+              forced: Tuple = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -526,6 +539,19 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
 
     use_mono_inter = cfg.has_monotone and cfg.monotone_intermediate
 
+    # forced splits (forcedsplits_filename; Tree::AddSplit forced paths
+    # in serial_tree_learner.cpp ForceSplits — UNVERIFIED): a PREORDER
+    # table (parents before children) applied ONE entry per round
+    # before free growth. Requires the pool (leaf_hist) to derive the
+    # forced threshold's left sums; the engine gates eligibility.
+    if cfg.n_forced <= 0:
+        forced = None
+    if forced is not None:
+        f_parent, f_is_left, f_feat, f_tbin = forced
+        M_f = cfg.n_forced
+        assert not cfg.hist_rebuild, \
+            "forced splits need the histogram pool"
+
     # ---- root ----------------------------------------------------------
     leaf_id0 = jnp.zeros(n_rows, dtype=i32)
     leaf_id0_c = jnp.zeros(n_rows_c, dtype=i32)
@@ -564,7 +590,10 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     state = GrowState(
         split_idx=jnp.array(0, i32),
         num_leaves=jnp.array(1, i32),
-        has_split=jnp.isfinite(root_best["gain"]),
+        # pending forced entries must enter the loop even when the free
+        # root search found nothing (forced splits bypass gain checks)
+        has_split=(jnp.array(True) if forced is not None
+                   else jnp.isfinite(root_best["gain"])),
         leaf_id=leaf_id0,
         # rebuild mode carries no pool — a 1-element placeholder keeps
         # the NamedTuple structure static
@@ -614,6 +643,9 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             (L, L + 1) if use_mono_inter else (1, 1), jnp.bool_),
         leaf_id_c=(leaf_id0_c if compact is not None
                    else jnp.zeros(1, i32)),
+        forced_ptr=jnp.zeros((), i32),
+        forced_target=(jnp.where(f_parent < 0, 0, -1).astype(i32)
+                       if forced is not None else jnp.zeros(1, i32)),
     )
 
     node_trash = L - 1  # real nodes occupy 0..L-2
@@ -625,6 +657,43 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     def body(s: GrowState) -> GrowState:
         gains = _masked_gains(s.best_gain, s.leaf_depth, s.num_leaves,
                               cfg.max_depth)
+        if forced is not None:
+            # ---- forced-split round (one table entry per round) ------
+            fp = s.forced_ptr
+            in_forced = fp < M_f
+            fpc = jnp.minimum(fp, M_f - 1)
+            f_tgt = s.forced_target[fpc]
+            ff_i = f_feat[fpc]
+            ftb_i = f_tbin[fpc]
+            tgt_c = jnp.clip(f_tgt, 0, L)
+            # the forced threshold's child sums from the pool histogram
+            # (missing-right semantics, dir 0 of the numerical scan)
+            hist_tf = jax.lax.dynamic_index_in_dim(
+                s.leaf_hist, tgt_c, axis=0, keepdims=False)   # [F,B,3]
+            col_f = jax.lax.dynamic_index_in_dim(
+                hist_tf, ff_i, axis=0, keepdims=False)        # [B,3]
+            bidx_f = jnp.arange(B, dtype=i32)
+            nanb_f = feat_has_nan[ff_i] \
+                & (bidx_f == feat_num_bin[ff_i] - 1)
+            lm_f = (bidx_f <= ftb_i) & ~nanb_f
+            f_lsums = jnp.sum(col_f * lm_f[:, None], axis=0)
+            f_psums = s.leaf_sums[tgt_c]
+            f_rsums = f_psums - f_lsums
+            # a forced split bypasses gain/min_data checks (it is
+            # forced) but both children must receive rows and the
+            # target must respect max_depth; otherwise the entry and
+            # its subtree are skipped
+            applied = (in_forced & (f_tgt >= 0)
+                       & (f_lsums[2] > 0) & (f_rsums[2] > 0))
+            if cfg.max_depth > 0:
+                applied = applied \
+                    & (s.leaf_depth[tgt_c] < cfg.max_depth)
+            gains = jnp.where(
+                in_forced,
+                jnp.where(applied
+                          & (jnp.arange(L + 1, dtype=i32) == tgt_c),
+                          jnp.float32(3e38), NEG_INF),
+                gains)
         top_gain, top_leaf = jax.lax.top_k(gains, Kb)
         remaining = (L - 1) - s.split_idx
         valid = jnp.isfinite(top_gain) \
@@ -643,30 +712,59 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         # per-leaf attributes packed as a [Kb, 6] matrix: one small MXU
         # matmul replaces every per-row lookup.
         lf = s.leaf_id
-        bfeat_k = s.best_feature[tl_safe]
-        attr_cols = [bfeat_k.astype(jnp.float32),
-                     s.best_threshold[tl_safe].astype(jnp.float32),
-                     s.best_default_left[tl_safe].astype(jnp.float32),
+        # per-lane split attributes; a forced round substitutes the
+        # forced entry's feature/threshold for lane 0
+        feat_sel = s.best_feature[tl_safe]
+        thr_sel = s.best_threshold[tl_safe]
+        dl_sel = s.best_default_left[tl_safe]
+        gain_rec = top_gain
+        lsums_sel = s.best_left_sums[tl_safe]      # [Kb, 3]
+        rsums_sel = s.best_right_sums[tl_safe]
+        cat_sel = (s.best_is_cat[tl_safe] if cfg.has_categorical
+                   else None)
+        bs_sel = (s.best_cat_bitset[tl_safe] if cfg.has_categorical
+                  else None)
+        if forced is not None:
+            from ..ops.split import leaf_gain as _lg
+            flane = (jnp.arange(Kb, dtype=i32) == 0) & applied
+            feat_sel = jnp.where(flane, ff_i, feat_sel)
+            thr_sel = jnp.where(flane, ftb_i, thr_sel)
+            dl_sel = jnp.where(flane, False, dl_sel)
+            lsums_sel = jnp.where(flane[:, None], f_lsums, lsums_sel)
+            rsums_sel = jnp.where(flane[:, None], f_rsums, rsums_sel)
+            g_forced = (_lg(f_lsums[0], f_lsums[1], cfg.lambda_l1,
+                            cfg.lambda_l2)
+                        + _lg(f_rsums[0], f_rsums[1], cfg.lambda_l1,
+                              cfg.lambda_l2)
+                        - _lg(f_psums[0], f_psums[1], cfg.lambda_l1,
+                              cfg.lambda_l2))
+            gain_rec = jnp.where(flane, g_forced, gain_rec)
+            if cfg.has_categorical:
+                cat_sel = jnp.where(flane, False, cat_sel)
+                bs_sel = jnp.where(flane[:, None], jnp.uint32(0),
+                                   bs_sel)
+        attr_cols = [feat_sel.astype(jnp.float32),
+                     thr_sel.astype(jnp.float32),
+                     dl_sel.astype(jnp.float32),
                      new_ids.astype(jnp.float32),
-                     feat_num_bin[bfeat_k].astype(jnp.float32),
-                     feat_has_nan[bfeat_k].astype(jnp.float32)]
+                     feat_num_bin[feat_sel].astype(jnp.float32),
+                     feat_has_nan[feat_sel].astype(jnp.float32)]
         if cfg.has_categorical:
             # bitset words split into 16-bit halves: exact in float32,
             # so the same masked matmul carries them per row
-            bs_k = s.best_cat_bitset[tl_safe]                 # [Kb, W]
-            attr_cols.append(s.best_is_cat[tl_safe].astype(jnp.float32))
+            attr_cols.append(cat_sel.astype(jnp.float32))
             attr_cols.extend(jnp.moveaxis(
-                (bs_k & jnp.uint32(0xFFFF)).astype(jnp.float32), 1, 0))
+                (bs_sel & jnp.uint32(0xFFFF)).astype(jnp.float32), 1, 0))
             attr_cols.extend(jnp.moveaxis(
-                (bs_k >> jnp.uint32(16)).astype(jnp.float32), 1, 0))
+                (bs_sel >> jnp.uint32(16)).astype(jnp.float32), 1, 0))
         if cfg.has_bundles:
             # EFB: the row pass reads the PHYSICAL bundle column and
             # recovers the logical bin via the member's offset/default
             attr_cols.extend([
-                bphys_col[bfeat_k].astype(jnp.float32),
-                bstart[bfeat_k].astype(jnp.float32),
-                bbundled[bfeat_k].astype(jnp.float32),
-                bdef[bfeat_k].astype(jnp.float32)])
+                bphys_col[feat_sel].astype(jnp.float32),
+                bstart[feat_sel].astype(jnp.float32),
+                bbundled[feat_sel].astype(jnp.float32),
+                bdef[feat_sel].astype(jnp.float32)])
         packed = jnp.stack(attr_cols, axis=1)
 
         def apply_splits(lf_vec, bins_mat):
@@ -739,8 +837,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                      if compact is not None else s.leaf_id_c)
         hist_lid = leaf_id_c if compact is not None else leaf_id
 
-        lsums = s.best_left_sums[tl_safe]      # [Kb, 3]
-        rsums = s.best_right_sums[tl_safe]
+        lsums = lsums_sel                      # [Kb, 3]
+        rsums = rsums_sel
         psums = s.leaf_sums[tl_safe]
         if cfg.hist_rebuild:
             # ---- both children direct, one fused scan ------------------
@@ -805,7 +903,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 return calc_leaf_output(
                     sums[..., 0], sums[..., 1], cfg.lambda_l1,
                     cfg.lambda_l2 + cfg.cat_l2, cfg.max_delta_step)
-            cat_split = s.best_is_cat[tl_safe]
+            cat_split = cat_sel
             lvals = jnp.where(cat_split, leaf_out_cat(lsums), lvals)
             rvals = jnp.where(cat_split, leaf_out_cat(rsums), rvals)
 
@@ -821,7 +919,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
 
         # ---- constraint propagation (monotone_constraints.hpp) ---------
         if cfg.has_monotone:
-            m_k = mono[s.best_feature[tl_safe]].astype(jnp.float32)
+            m_k = mono[feat_sel].astype(jnp.float32)
             if use_mono_inter:
                 # intermediate mode: bounds recomputed each round from
                 # the CURRENT leaf outputs of every constrained node's
@@ -897,7 +995,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         else:
             child_lower = child_upper = None
         if cfg.has_interaction:
-            fk = s.best_feature[tl_safe]
+            fk = feat_sel
             used_k = s.leaf_used[tl_safe] \
                 | (fk[:, None] == jnp.arange(F_meta, dtype=i32)[None, :])
             # a group is usable iff it contains EVERY feature on the path
@@ -976,19 +1074,18 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             best_is_cat=s.best_is_cat.at[ids2].set(bests["is_cat"]),
             best_cat_bitset=s.best_cat_bitset.at[ids2].set(
                 bests["cat_bitset"]),
-            split_feature=s.split_feature.at[node_ids].set(
-                s.best_feature[tl_safe]),
-            threshold_bin=s.threshold_bin.at[node_ids].set(
-                s.best_threshold[tl_safe]),
-            default_left=s.default_left.at[node_ids].set(
-                s.best_default_left[tl_safe]),
+            split_feature=s.split_feature.at[node_ids].set(feat_sel),
+            threshold_bin=s.threshold_bin.at[node_ids].set(thr_sel),
+            default_left=s.default_left.at[node_ids].set(dl_sel),
             node_is_cat=s.node_is_cat.at[node_ids].set(
-                s.best_is_cat[tl_safe]),
+                cat_sel if cfg.has_categorical
+                else s.best_is_cat[tl_safe]),
             node_cat_bitset=s.node_cat_bitset.at[node_ids].set(
-                s.best_cat_bitset[tl_safe]),
+                bs_sel if cfg.has_categorical
+                else s.best_cat_bitset[tl_safe]),
             left_child=lc,
             right_child=rc,
-            split_gain=s.split_gain.at[node_ids].set(top_gain),
+            split_gain=s.split_gain.at[node_ids].set(gain_rec),
             internal_value=s.internal_value.at[node_ids].set(
                 s.leaf_value[tl_safe] if cfg.path_smooth > 0.0
                 else leaf_out(psums)),
@@ -1011,11 +1108,25 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             mono_left=ml,
             mono_right=mr,
             leaf_id_c=leaf_id_c,
+            forced_ptr=(s.forced_ptr
+                        + jnp.where(in_forced, 1, 0).astype(i32)
+                        if forced is not None else s.forced_ptr),
+            forced_target=(jnp.where(
+                in_forced & (f_parent == fp),
+                jnp.where(applied,
+                          jnp.where(f_is_left, tgt_c, s.num_leaves),
+                          -2),
+                s.forced_target).astype(i32)
+                if forced is not None else s.forced_target),
         )
         next_gains = _masked_gains(new.best_gain, new.leaf_depth,
                                    new.num_leaves, cfg.max_depth)
-        return new._replace(
-            has_split=jnp.isfinite(jnp.max(next_gains)) & (nv > 0))
+        keep_going = jnp.isfinite(jnp.max(next_gains)) & (nv > 0)
+        if forced is not None:
+            # skipped/cancelled forced rounds split nothing (nv == 0)
+            # but must not terminate growth while entries remain
+            keep_going = keep_going | (new.forced_ptr < M_f)
+        return new._replace(has_split=keep_going)
 
     final = jax.lax.while_loop(cond, body, state)
 

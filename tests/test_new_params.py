@@ -277,16 +277,70 @@ def test_forcedbins_boundaries_respected(tmp_path):
     assert any(abs(t - 0.337) < 1e-12 for t in thresholds), thresholds
 
 
+def test_forcedsplits_structure_respected(tmp_path):
+    """forcedsplits_filename (ForceSplits): the JSON split tree must
+    form the top of EVERY tree — root on f1 at 0.25, its left child on
+    f2 at -0.5 — regardless of what free search would pick."""
+    import json
+    rng = np.random.default_rng(13)
+    X = rng.uniform(-1, 1, size=(4000, 4))
+    # f0 dominates, so free search would never pick f1 at the root
+    y = 3.0 * X[:, 0] + 0.2 * X[:, 1] + rng.normal(scale=0.1, size=4000)
+    fs = str(tmp_path / "forced.json")
+    with open(fs, "w") as f:
+        json.dump({"feature": 1, "threshold": 0.25,
+                   "left": {"feature": 2, "threshold": -0.5}}, f)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "forcedsplits_filename": fs, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    used = bst.engine.train_set.used_features
+    for t in bst.engine.models:
+        sf = np.asarray(t.split_feature)
+        assert used[int(sf[0])] == 1, "root split must be forced to f1"
+        # node 1 is the left child's forced split (created round 2)
+        assert used[int(sf[1])] == 2
+    # model trains sanely despite the forced top
+    pred = bst.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
+    # thresholds land at the bin boundary containing the forced value
+    info = bst.dump_model()["tree_info"][0]["tree_structure"]
+    assert abs(info["threshold"] - 0.25) < 0.05
+    # plain training (no forced file) picks f0 at the root instead
+    plain = lgb.train({"objective": "regression", "num_leaves": 15,
+                       "verbosity": -1}, lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    t0 = plain.engine.models[0]
+    assert used[int(np.asarray(t0.split_feature)[0])] == 0
+
+
+def test_forcedsplits_unused_feature_skipped(tmp_path):
+    """A forced split on a constant (dropped) feature is skipped with
+    its subtree; training proceeds normally."""
+    import json
+    rng = np.random.default_rng(14)
+    X = rng.normal(size=(1500, 3))
+    X[:, 2] = 7.0                       # constant -> dropped
+    y = X[:, 0] + rng.normal(scale=0.2, size=1500)
+    fs = str(tmp_path / "forced.json")
+    with open(fs, "w") as f:
+        json.dump({"feature": 2, "threshold": 0.0}, f)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "forcedsplits_filename": fs, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst.engine._n_forced == 0
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.8
+
+
 def test_unimplemented_param_warns():
     from lightgbm_tpu.config import Config, _WARNED_UNIMPLEMENTED
     from lightgbm_tpu.utils import log
-    _WARNED_UNIMPLEMENTED.discard("forcedsplits_filename")
+    _WARNED_UNIMPLEMENTED.discard("parser_config_file")
     msgs = []
     log.register_callback(msgs.append)
     try:
         Config({"objective": "binary", "verbosity": 1,
-                "forcedsplits_filename": "splits.json"})
+                "parser_config_file": "parser.json"})
     finally:
         log.register_callback(None)
         log.set_verbosity(-1)
-    assert any("forcedsplits_filename" in m for m in msgs), msgs
+    assert any("parser_config_file" in m for m in msgs), msgs
