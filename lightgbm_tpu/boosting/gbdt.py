@@ -998,16 +998,26 @@ class GBDT:
         # differ only in accumulation order (exact in quantized mode).
         renews_obj = (type(obj).renew_tree_output
                       is not Objective.renew_tree_output)
-        # the sampled rows ride ONE multi-operand lax.sort; the TPU
-        # compiler's sort lowering is superlinear in operand count
-        # (measured round 3: 11 operands ~67 s compile, two such sorts
-        # 204 s, F=200's 55 operands never finished), so compaction is
-        # gated to shapes whose packed payload fits one small sort —
-        # wider datasets keep the masked GOSS path
-        _F_sort = len(self.train_set.used_features)
-        _per_w = 4 if self.train_set.binned.dtype.itemsize == 1 else 2
-        _n_sort_ops = (1 + (_F_sort + _per_w - 1) // _per_w
-                       + 2 * self.num_class + 2)
+        # Round 3 compacted via ONE multi-operand lax.sort, whose
+        # superlinear compile cost gated it to F <= ~32 packed columns.
+        # Round 4 replaced the sort with the Pallas row-compaction
+        # kernel (ops/compact.py): per-block permutation matmuls at any
+        # width (~5 ms vs 13 ms at 1M x 28, and Bosch F=200 / Criteo /
+        # MSLR widths now compact too — docs/perf.md "Row compaction
+        # kernel").
+        import math as _math
+        from ..ops.compact import (compact_rows, compact_rows_xla,
+                                   compaction_out_cols, plan_compaction)
+        # compaction block size: <= 1024 (kernel VMEM budget) and a
+        # divisor of n_pad (which is a rows_per_block multiple); a
+        # degenerate divisor (odd tpu_rows_per_block values) would
+        # shred the kernel grid into sub-lane-width matmuls, so those
+        # shapes keep the masked path
+        R_c = _math.gcd(1024, gcfg.rows_per_block)
+        frac = top_rate + other_rate
+        n_sub = compaction_out_cols(
+            int(np.ceil(self.data.n_pad * frac)) + 8192,
+            R_c, gcfg.rows_per_block)
         use_goss_compact = (bool(self.config.tpu_goss_compact)
                            and self.config.data_sample_strategy == "goss"
                            and mesh is None and not self.has_bundles
@@ -1015,15 +1025,24 @@ class GBDT:
                            and not (use_quant and renew_quant)
                            and not getattr(obj, "has_pos_state", False)
                            and top_rate + other_rate < 1.0
-                           and _n_sort_ops <= 13)
+                           and R_c >= 256
+                           # the compacted buffer (sampled rows + write
+                           # slack) must genuinely shrink the scan; tiny
+                           # datasets / near-1.0 fractions keep the
+                           # masked path (also guarantees the kernel's
+                           # write windows never clamp = never drop a
+                           # sampled row)
+                           and n_sub < self.data.n_pad
+                           # the XLA scatter fallback serializes ON TPU
+                           # (docs/perf.md) — without the Pallas path
+                           # (max_bin>256 / tpu_double_precision_hist /
+                           # tpu_use_pallas=false) keep the masked scan
+                           and (self.use_pallas
+                                or jax.default_backend() != "tpu"))
         self._use_goss_compact = use_goss_compact
         if use_goss_compact:
-            from ..ops.histogram import pad_rows as _pad_rows
             dd = self.data
             n_full = dd.n_pad
-            frac = top_rate + other_rate
-            n_sub = min(_pad_rows(int(np.ceil(n_full * frac)) + 8192,
-                                  gcfg.rows_per_block), n_full)
 
             def step_goss_compact_impl(bins, bins_t, label, weight,
                                        valid_mask, score, allowed,
@@ -1034,68 +1053,33 @@ class GBDT:
                 sel = mask_count > 0
                 # TPU note: jnp.nonzero / gathers at computed indices
                 # lower to serialized scatter/slice loops (~1s at 1M
-                # rows). ONE multi-operand lax.sort moves the sampled
-                # rows to the front instead (~13 ms at F=28): the key
-                # orders selected rows (by index) before unselected, and
-                # bins + grad/hess/masks ride along as payload.
-                iota = jnp.arange(n_full, dtype=jnp.int32)
-                skey = jnp.where(sel, iota, iota + n_full)
+                # rows). The compaction kernel moves the sampled rows
+                # into a fixed-size front buffer with per-block one-hot
+                # permutation matmuls instead; grad/hess/masks ride as
+                # value channels of the same kernel call.
                 g2 = g if K > 1 else g[:, None]
                 h2 = h if K > 1 else h[:, None]
-                # bin columns ride the sort packed 4-per-uint32: XLA's
-                # multi-operand sort lowering scales badly with operand
-                # count (33 operands at F=28 compiled for >25 min)
-                Fb = bins.shape[1]
-                lane_bits = 8 * bins.dtype.itemsize   # uint8 or uint16
-                per_w = 32 // lane_bits
-                F4 = (Fb + per_w - 1) // per_w
-                b32 = []
-                for w in range(F4):
-                    word = jnp.zeros(n_full, jnp.uint32)
-                    for j in range(per_w):
-                        f = per_w * w + j
-                        if f < Fb:
-                            word = word | (bins[:, f].astype(jnp.uint32)
-                                           << (lane_bits * j))
-                    b32.append(word)
-                payloads = (b32
-                            + [g2[:, k] for k in range(K)]
-                            + [h2[:, k] for k in range(K)]
-                            + [mask_gh, mask_count])
-                # XLA's multi-operand sort compiles superlinearly in operand
-                # count (33 operands took >25 min at F=28 in round 2;
-                # F=200 would be ~55): split the payload into bounded
-                # groups, each sorted with the SAME key. skey is unique
-                # per row, so every group sees the identical permutation
-                # one group under the _n_sort_ops <= 13 eligibility
-                # gate — the grouping loop exists only as structure for
-                # a future cheaper compaction primitive (multiple sorts
-                # COMPOUND compile cost, see docs/perf.md)
-                GROUP = 12
-                cut = [None] * len(payloads)
-                key_cut = None
-                for s0 in range(0, len(payloads), GROUP):
-                    grp = payloads[s0:s0 + GROUP]
-                    so = jax.lax.sort([skey] + grp, num_keys=1,
-                                      is_stable=False)
-                    if key_cut is None:
-                        key_cut = so[0][:n_sub]
-                    for j, arr in enumerate(so[1:]):
-                        cut[s0 + j] = arr[:n_sub]
-                lane = key_cut < n_full
-                cols = []
-                lane_mask = jnp.uint32((1 << lane_bits) - 1)
-                for f in range(Fb):
-                    w, j = divmod(f, per_w)
-                    cols.append(((cut[w] >> (lane_bits * j))
-                                 & lane_mask).astype(bins.dtype))
-                bins_c = jnp.stack(cols, axis=1)
-                g_c = jnp.stack(cut[F4:F4 + K], axis=1)
-                h_c = jnp.stack(cut[F4 + K:F4 + 2 * K], axis=1)
-                mgh_c = jnp.where(lane, cut[-2], 0.0)
-                mc_c = jnp.where(lane, cut[-1], 0.0)
-                bins_t_c = (bins_c.astype(jnp.int8).T
-                            if bins_t is not None else None)
+                vals_all = jnp.concatenate(
+                    [g2.T, h2.T, mask_gh[None], mask_count[None]],
+                    axis=0).astype(jnp.float32)       # [2K+2, n]
+                dest, algn, rem = plan_compaction(sel, R_c, n_sub)
+                if bins_t is not None:
+                    bins_t_c, vc = compact_rows(
+                        bins_t, vals_all, dest, algn, rem,
+                        out_cols=n_sub, rows_per_block=R_c)
+                    # int8 -> uint8 reinterpret restores bin values for
+                    # the row-major partition path
+                    bins_c = bins_t_c.T.astype(bins.dtype)
+                else:
+                    bt_any, vc = compact_rows_xla(
+                        bins.T, vals_all, dest, algn, rem,
+                        out_cols=n_sub, rows_per_block=R_c)
+                    bins_c = bt_any.T
+                    bins_t_c = None
+                g_c = vc[:K].T
+                h_c = vc[K:2 * K].T
+                mgh_c = vc[2 * K]
+                mc_c = vc[2 * K + 1]
                 qkey = jax.random.fold_in(key, 0x9e37)
                 import dataclasses as _dc
                 gcfg_c = _dc.replace(gcfg, hist_compact=True)

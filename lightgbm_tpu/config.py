@@ -310,17 +310,20 @@ _TRUE_STRINGS = {"true", "1", "t", "yes", "y", "+", "on"}
 _FALSE_STRINGS = {"false", "0", "f", "no", "n", "-", "off"}
 
 # Parameters accepted for upstream compatibility but NOT acted on:
-# setting a NON-DEFAULT value warns once per process (never silently
-# ignored — reference parity per config_auto.cpp is "every documented
-# param acts"; tests/test_param_audit.py asserts this table + source
-# references cover the whole _PARAMS table). name -> what's missing.
+# setting a NON-DEFAULT value warns once per distinct (name, value) —
+# a fresh run with a DIFFERENT value re-warns, while the 2-3 Config
+# objects one train() call builds from the same params don't repeat it
+# (never silently ignored — reference parity per config_auto.cpp is
+# "every documented param acts"; tests/test_param_audit.py asserts this
+# table + source references cover the whole _PARAMS table).
+# name -> what's missing.
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "cegb_penalty_feature_lazy":
         "per-row feature-acquisition tracking; use "
         "cegb_penalty_feature_coupled",
     "parser_config_file": "custom text-parser plugins are not supported",
 }
-_WARNED_UNIMPLEMENTED: set = set()
+_WARNED_PARAM_VALUES: set = set()
 
 # Parameters whose upstream effect legitimately DISSOLVES on this
 # backend: they are implementation/performance hints whose correct
@@ -522,9 +525,9 @@ class Config:
         mcm = str(self.monotone_constraints_method).lower()
         if mcm not in ("basic", "intermediate", "advanced"):
             log.fatal(f"Unknown monotone_constraints_method {mcm!r}")
-        if mcm == "advanced" and "monotone_advanced" \
-                not in _WARNED_UNIMPLEMENTED:
-            _WARNED_UNIMPLEMENTED.add("monotone_advanced")
+        if mcm == "advanced" \
+                and ("monotone_advanced", mcm) not in _WARNED_PARAM_VALUES:
+            _WARNED_PARAM_VALUES.add(("monotone_advanced", mcm))
             log.warning("monotone_constraints_method=advanced falls "
                         "back to the intermediate method (the advanced "
                         "slack-redistribution refinement is not "
@@ -540,9 +543,10 @@ class Config:
         for name, detail in UNIMPLEMENTED_PARAMS.items():
             _t, default, _a, _b = _PARAMS[name]
             val = getattr(self, name)
+            dedup_key = (name, repr(val))
             if (name in self.raw_params and val != default
-                    and name not in _WARNED_UNIMPLEMENTED):
-                _WARNED_UNIMPLEMENTED.add(name)
+                    and dedup_key not in _WARNED_PARAM_VALUES):
+                _WARNED_PARAM_VALUES.add(dedup_key)
                 log.warning(f"{name} is accepted but not implemented "
                             f"({detail}); the setting has no effect")
 

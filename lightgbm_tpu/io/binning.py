@@ -174,8 +174,12 @@ class BinMapper:
             m.bin_upper_bound = merged
             m.num_bin = len(merged) + (1 if m.missing_type == MISSING_NAN
                                        else 0)
+            # most_freq_bin tracks default_bin whenever the feature had
+            # zero mass; recompute both against the merged bounds so
+            # neither can point at a pre-merge bin index
             m.default_bin = int(np.searchsorted(merged, 0.0,
                                                 side="left"))
+            m.most_freq_bin = m.default_bin if m._zero_mass else 0
         return m
 
     @staticmethod
@@ -259,6 +263,7 @@ class BinMapper:
                       max_value=float(finite.max()) if len(finite) else 0.0)
         m.default_bin = int(np.searchsorted(ub, 0.0, side="left"))
         m.most_freq_bin = m.default_bin if zero_cnt > 0 else 0
+        m._zero_mass = zero_cnt > 0   # read by the forcedbins merge
         return m
 
     @staticmethod

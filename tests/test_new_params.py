@@ -386,9 +386,10 @@ def test_forcedsplits_unused_feature_skipped(tmp_path):
 
 
 def test_unimplemented_param_warns():
-    from lightgbm_tpu.config import Config, _WARNED_UNIMPLEMENTED
+    from lightgbm_tpu.config import Config, _WARNED_PARAM_VALUES
     from lightgbm_tpu.utils import log
-    _WARNED_UNIMPLEMENTED.discard("parser_config_file")
+    _WARNED_PARAM_VALUES.discard(("parser_config_file",
+                                  repr("parser.json")))
     msgs = []
     log.register_callback(msgs.append)
     try:

@@ -10,10 +10,40 @@ Reference: upstream honors every documented param via config_auto.cpp
 (SURVEY.md:88) — this test is the enforcement mechanism for that parity
 claim at param granularity."""
 import inspect
+import io
 import pathlib
 import re
+import tokenize
 
 import lightgbm_tpu.config as C
+
+
+def _strip_comments_and_docstrings(source: str) -> str:
+    """Drop COMMENT tokens and statement-level strings (docstrings) so a
+    param mentioned only in prose cannot pass the audit. String literals
+    inside expressions survive — ``params["max_bin"]`` /
+    ``getattr(cfg, "max_bin")`` are real consumption."""
+    out = []
+    prev = None
+    in_docstring = False
+    toks = tokenize.generate_tokens(io.StringIO(source).readline)
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            continue
+        if tok.type == tokenize.STRING:
+            # statement-level string, or a continuation segment of one
+            # (implicit concatenation: "a" "b" tokenizes as two STRINGs)
+            if in_docstring or prev in (
+                    None, tokenize.NEWLINE, tokenize.NL, tokenize.INDENT,
+                    tokenize.DEDENT):
+                in_docstring = True
+                continue
+        elif tok.type != tokenize.NL:
+            in_docstring = False
+        if tok.type != tokenize.NL:
+            prev = tok.type
+        out.append(tok.string)
+    return " ".join(out)
 
 
 def _package_source_without_param_table() -> str:
@@ -22,13 +52,14 @@ def _package_source_without_param_table() -> str:
     for p in sorted(pkg.rglob("*.py")):
         if p.name == "config.py":
             continue
-        src.append(p.read_text())
+        src.append(_strip_comments_and_docstrings(p.read_text()))
     # config.py consumes some params itself (CheckParamConflict fixups),
     # but its _PARAMS table mentions every name — include only the
     # consuming code, not the table
-    src.append(inspect.getsource(C.Config._post_process))
-    src.append(inspect.getsource(type(C.Config(
-        {"verbosity": -1})).num_tree_per_iteration.fget))
+    src.append(_strip_comments_and_docstrings(
+        inspect.getsource(C.Config._post_process)))
+    src.append(_strip_comments_and_docstrings(inspect.getsource(
+        type(C.Config({"verbosity": -1})).num_tree_per_iteration.fget)))
     return "\n".join(src)
 
 
