@@ -3,38 +3,59 @@
 Driver contract: print ONE JSON line
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
 
-Config mirrors BASELINE.json's flagship: binary classification, 28 dense
-features, num_leaves=127, max_bin=255. The dataset is synthesized (no
-network in this environment; Higgs itself is a download). Default 1M
-rows — matching the "Higgs-1M CPU hist baseline" config shape; pass
-``--rows 10000000`` for the flagship Higgs-10M shape (BASELINE.json's
-headline metric), which also reports binning time and peak HBM.
+Config mirrors BASELINE.json's flagship headline: Higgs-10M, binary
+classification, 28 dense features, num_leaves=127, max_bin=255. The
+dataset is synthesized (no network in this environment; Higgs itself
+is a download). Default 10M rows with GOSS + quantized gradients —
+both reference-native speed features (goss.hpp + the gradient
+discretizer) — which reach a BETTER held-out AUC than plain full-row
+f32 scans at this shape (10M: 0.9467 vs 0.9433; 1M at equal 90
+rounds: 0.9514 vs 0.9478 — measured round 4). For continuity with
+rounds 1-3 the same run also times the higgs-1M PLAIN configuration
+and embeds it in the metric string (``plain1m=...``), so protocol
+changes can never masquerade as speedups.
 
-The default run uses GOSS — the reference's own flagship sampling
-technique (the NeurIPS'17 paper's core contribution) with this repo's
-histogram-only row compaction — which is both ~2x faster than plain
-full-row scans AND reaches a better held-out AUC at equal iterations
-(0.9511 vs 0.9478; docs/perf.md). Pass --plain for full-row scans.
+Protocol (round-4 revision, addressing ADVICE r3):
+- the model trains warmup+iters rounds with warmup = iters + 10 for
+  EVERY config (GOSS needs the +10 to get past its unsampled first
+  1/learning_rate rounds; plain keeps the same total so AUCs are
+  at identical round counts);
+- held-out AUC is measured at that fixed round count, comparable
+  across configs and rounds;
+- then THREE equal timed windows re-run the same chunk length and the
+  MEDIAN is reported (tagged ``median-of-3`` in the metric string; a
+  single window through the tunneled chip occasionally catches a
+  stall — observed 5.3 vs 16.6 it/s back-to-back — and best-of-N
+  would bias up).
 
-Protocol: the model trains warmup+iters rounds, the held-out AUC is
-measured THERE (fixed iteration count, comparable across runs), then a
-second timed window re-times the same chunk length and the BEST window
-is reported (steady-state throughput; a single window through the
-tunneled chip occasionally catches a stall).
+Quality guards: (1) the main holdout AUC above; (2) a second guard
+dataset (``synth_guard``) with strong interactions, 10% NaNs and two
+categorical columns, trained at 200k rows — its AUC collapses if
+categorical splits or missing-value routing regress (measured on the
+v5e: 0.868 with categorical handling, 0.836 with categoricals treated
+numeric; the 0.85 floor sits between).
+The main synthetic is near-linearly separable (holdout AUC ~0.95 where
+real Higgs sits ~0.845, BASELINE.md) and cannot catch those paths;
+the guard exists for exactly that. Neither guard can catch
+regressions confined to ranking/multiclass/DART paths — those live in
+benchmarks/suite.py.
 
-Extra flags (all optional; defaults reproduce the driver run):
+Extra flags (defaults reproduce the driver run):
   --rows N --holdout N --iters N --leaf-batch K --hist-mode pool|rebuild
-  --quant (use_quantized_grad) --plain (full-row scans)
-  --goss (explicit GOSS override, the default; last of --plain/--goss
-  wins)
+  --plain (full-row f32 scans; also disables quantization)
+  --goss/--quant (re-enable pieces after --plain; last wins)
+  --no-guard2 / --no-plain1m (skip the secondary sections)
 
 vs_baseline: BASELINE.md holds NO verified reference numbers (empty
-mount). We compare against 1.0 iters/sec — the ballpark of CPU
-hist-LightGBM on Higgs-1M-class data per BASELINE.md's unverified
-recollection table — so vs_baseline > 1 means faster than CPU LightGBM.
+mount). The ballpark comparator is CPU hist-LightGBM ~1.0 it/s at
+Higgs-1M (BASELINE.md recollection), scaled linearly to 0.1 at 10M
+and doubled for GOSS (~2x per the NeurIPS'17 ablations) -> 0.2
+iters/sec for the default config. All UNVERIFIED; vs_baseline > 1
+means faster than that recollection of CPU LightGBM.
 """
 import argparse
 import json
+import statistics
 import sys
 import time
 
@@ -43,11 +64,19 @@ import numpy as np
 N_FEATURES = 28
 NUM_LEAVES = 127
 MAX_BIN = 255
-CPU_LIGHTGBM_BASELINE_ITERS_PER_SEC = 1.0  # UNVERIFIED, see BASELINE.md
+# UNVERIFIED ballparks, see module docstring + BASELINE.md
+CPU_LIGHTGBM_BASELINE = {
+    (True, 1_000_000): 2.0,     # (goss, rows): CPU GOSS at 1M
+    (False, 1_000_000): 1.0,    # CPU plain hist at 1M
+    (True, 10_000_000): 0.2,
+    (False, 10_000_000): 0.1,
+}
 
 
 def synth_higgs(n, f, seed=0):
-    """Higgs-like: mixture of informative kinematic-ish features."""
+    """Higgs-like: mixture of informative kinematic-ish features.
+    UNCHANGED since round 1 (headline continuity) — near-linear, no
+    NaNs/categoricals; see synth_guard for those paths."""
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(n, f)).astype(np.float32)
     w = rng.normal(size=f)
@@ -55,6 +84,30 @@ def synth_higgs(n, f, seed=0):
              + 0.5 * np.abs(X[:, 2]) - 0.4)
     y = (logit + rng.normal(scale=1.0, size=n) > 0).astype(np.float64)
     return X.astype(np.float64), y
+
+
+def synth_guard(n, seed=7):
+    """Categorical/NaN/interaction guard dataset: 10 numeric features
+    (pairwise interactions dominate), one 12-way and one 40-way
+    categorical with target-dependent effects, 10% NaNs in half the
+    numeric columns (informative missingness)."""
+    rng = np.random.default_rng(seed)
+    Xn = rng.normal(size=(n, 10)).astype(np.float64)
+    c1 = rng.integers(0, 12, size=n)
+    c2 = rng.integers(0, 40, size=n)
+    eff1 = rng.normal(size=12)[c1] * 1.2
+    eff2 = rng.normal(size=40)[c2] * 0.8
+    logit = (1.0 * Xn[:, 0] * Xn[:, 1] + 0.9 * Xn[:, 2] * Xn[:, 3]
+             - 0.7 * Xn[:, 4] * np.abs(Xn[:, 5]) + eff1 + eff2)
+    # informative missingness: NaN rows carry signal
+    for j in range(5):
+        miss = rng.uniform(size=n) < 0.10
+        logit = logit + np.where(miss, 0.6, 0.0)
+        Xn[miss, j] = np.nan
+    y = (logit + rng.normal(scale=1.0, size=n) > 0).astype(np.float64)
+    X = np.column_stack([Xn, c1.astype(np.float64),
+                         c2.astype(np.float64)])
+    return X, y
 
 
 def peak_hbm_gib():
@@ -67,34 +120,84 @@ def peak_hbm_gib():
         return None
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=1_000_000)
-    ap.add_argument("--holdout", type=int, default=100_000)
-    ap.add_argument("--iters", type=int, default=40)
-    # warmup must match the timed chunk length so the fused scan is
-    # compiled exactly once, outside the timed region
-    ap.add_argument("--warmup", type=int, default=None)
-    ap.add_argument("--leaf-batch", type=int, default=None)
-    ap.add_argument("--hist-mode", choices=["pool", "rebuild"],
-                    default=None)
-    ap.add_argument("--quant", action="store_true")
-    ap.add_argument("--goss", action="store_true", default=True)
-    ap.add_argument("--plain", dest="goss", action="store_false",
-                    help="disable GOSS (full-row scans)")
-    ap.add_argument("--precise", action="store_true",
-                    help="tpu_double_precision_hist (f32 histograms)")
-    args = ap.parse_args()
+def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
+               cat_features="auto"):
+    """Train warmup+iters rounds, AUC there, then median of N timed
+    windows of the same chunk length."""
+    import jax
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metric import AUCMetric
+
+    t_bin = time.time()
+    ds = lgb.Dataset(X, label=y, categorical_feature=cat_features)
+    cfg = Config(params)
+    eng = GBDT(cfg, ds)
+    bin_time = time.time() - t_bin
+    # warm the REMAINDER first (it absorbs GOSS's unsampled first
+    # 1/lr rounds), then one full timed-length chunk: that second call
+    # is the one that compiles the fused scan the windows reuse —
+    # running it after the GOSS activation boundary matters, else the
+    # fused GOSS chunk would first compile inside timed window 1
+    if warmup > iters:
+        eng.train_chunk(warmup - iters)
+    eng.train_chunk(min(iters, warmup))
+    jax.block_until_ready(eng.score)
+    rates = []
+    t0 = time.time()
+    eng.train_chunk(iters)
+    jax.block_until_ready(eng.score)
+    rates.append(iters / (time.time() - t0))
+    # held-out AUC at the fixed warmup+iters round count (equal across
+    # configs), between the timed windows so it inflates none of them
+    pred = eng.predict(X_ho)
+    auc = AUCMetric(cfg).eval(pred, y_ho, None)[0][1]
+    for _ in range(windows - 1):
+        t0 = time.time()
+        eng.train_chunk(iters)
+        jax.block_until_ready(eng.score)
+        rates.append(iters / (time.time() - t0))
+    return statistics.median(rates), auc, bin_time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--holdout", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=40)
+    # warmup matches the timed chunk length (+10 so GOSS gets past its
+    # unsampled first 1/lr rounds) for EVERY config -> equal-round AUCs
+    ap.add_argument("--warmup", type=int, default=None)
+    ap.add_argument("--windows", type=int, default=3)
+    ap.add_argument("--leaf-batch", type=int, default=None)
+    ap.add_argument("--hist-mode", choices=["pool", "rebuild"],
+                    default=None)
+    class _Plain(argparse.Action):
+        def __call__(self, parser, ns, values, option_string=None):
+            ns.goss = ns.quant = False   # parse-time: later flags win
+    ap.add_argument("--quant", action="store_true", default=True)
+    ap.add_argument("--no-quant", dest="quant", action="store_false")
+    ap.add_argument("--goss", action="store_true", default=True)
+    ap.add_argument("--plain", action=_Plain, nargs=0,
+                    help="full-row f32 scans (disables GOSS + quant; "
+                         "a later --goss/--quant re-enables that piece)")
+    ap.add_argument("--precise", action="store_true",
+                    help="tpu_double_precision_hist (f32 histograms)")
+    ap.add_argument("--no-guard2", dest="guard2", action="store_false",
+                    default=True)
+    ap.add_argument("--no-plain1m", dest="plain1m",
+                    action="store_false", default=True)
+    args = ap.parse_args()
+    if args.holdout is None:
+        args.holdout = max(100_000, args.rows // 20)
+    if args.warmup is None:
+        args.warmup = args.iters + 10
 
     X, y = synth_higgs(args.rows + args.holdout, N_FEATURES)
     X, X_ho = X[:args.rows], X[args.rows:]
     y, y_ho = y[:args.rows], y[args.rows:]
-    t_bin = time.time()
-    ds = lgb.Dataset(X, label=y)
     params = {"objective": "binary", "num_leaves": NUM_LEAVES,
               "max_bin": MAX_BIN, "learning_rate": 0.1,
               "verbosity": -1}
@@ -108,58 +211,63 @@ def main():
         params["data_sample_strategy"] = "goss"
     if args.precise:
         params["tpu_double_precision_hist"] = True
-    cfg = Config(params)
-    eng = GBDT(cfg, ds)
-    bin_time = time.time() - t_bin
 
-    # warmup (jit compile + cache); same chunk length as the timed run
-    # so the fused scan is compiled exactly once. GOSS keeps the first
-    # 1/learning_rate iterations unsampled (goss.hpp warmup), so its
-    # warmup extends past them to reach the fused GOSS chunk.
-    if args.warmup is None:
-        args.warmup = args.iters + (10 if args.goss else 0)
-    eng.train_chunk(args.warmup)
-    import jax
-    jax.block_until_ready(eng.score)
+    ips, auc, bin_time = run_config(X, y, X_ho, y_ho, params,
+                                    args.iters, args.warmup,
+                                    args.windows)
 
-    t0 = time.time()
-    eng.train_chunk(args.iters)
-    jax.block_until_ready(eng.score)
-    iters_per_sec = args.iters / (time.time() - t0)
+    extras = "; goss" if args.goss else "; full-rows"
+    if args.quant:
+        extras += "+quantized"
+    extras += f"; median-of-{args.windows}"
 
-    # held-out AUC at the FIXED warmup+iters round count (comparable
-    # across runs/configs), BEFORE the re-timing window below
-    from lightgbm_tpu.metric import AUCMetric
-    pred = eng.predict(X_ho)
-    auc = AUCMetric(cfg).eval(pred, y_ho, None)[0][1]
+    # continuity figure: the rounds-1..3 headline config (higgs-1M,
+    # plain full-row f32) timed in the same process on the main run's
+    # holdout rows
+    if args.plain1m and args.rows >= 1_000_000 and (
+            args.rows != 1_000_000 or args.goss or args.quant):
+        n1 = 1_000_000
+        p1 = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "max_bin": MAX_BIN, "learning_rate": 0.1,
+              "verbosity": -1}
+        # 40-iteration chunks: shorter ones fall below tpu_fuse_iters
+        # and pay per-iteration dispatch (measured 2x slower)
+        ips1, auc1, _ = run_config(
+            X[:n1], y[:n1], X_ho[:100_000], y_ho[:100_000], p1,
+            40, 50, windows=3)
+        extras += f"; plain1m={ips1:.2f}@auc{auc1:.4f}(median-of-3)"
 
-    # second timed window, best wins: a single window through the
-    # tunneled chip occasionally catches a stall/late compile (observed
-    # 5.3 vs 16.6 it/s on back-to-back identical runs)
-    t0 = time.time()
-    eng.train_chunk(args.iters)
-    jax.block_until_ready(eng.score)
-    iters_per_sec = max(iters_per_sec, args.iters / (time.time() - t0))
+    # categorical/NaN/interaction guard (see module docstring)
+    if args.guard2:
+        Xg, yg = synth_guard(250_000)
+        gp = {"objective": "binary", "num_leaves": 63, "max_bin": 255,
+              "learning_rate": 0.1, "verbosity": -1}
+        g_ips, g_auc, _ = run_config(Xg[:200_000], yg[:200_000],
+                                     Xg[200_000:], yg[200_000:], gp,
+                                     10, 40, windows=1,
+                                     cat_features=[10, 11])
+        extras += f"; guard2_auc={g_auc:.4f}"
+        if g_auc < 0.85:
+            extras += " GUARD2_BELOW_FLOOR(0.85)"
 
+    peak = peak_hbm_gib()
+    if peak is not None:
+        extras += f"; peak_hbm_gib={peak}"
     shape_tag = ("higgs1m-synth" if args.rows == 1_000_000
                  else f"higgs{args.rows // 1_000_000}m-synth"
                  if args.rows % 1_000_000 == 0
                  else f"higgs{args.rows}-synth")
-    extras = "; goss" if args.goss else "; full-rows"
-    if args.quant:
-        extras += "+quantized"
-    peak = peak_hbm_gib()
-    if peak is not None:
-        extras += f"; peak_hbm_gib={peak}"
+    base = CPU_LIGHTGBM_BASELINE.get(
+        (args.goss, args.rows),
+        (2.0 if args.goss else 1.0) * 1e6 / max(args.rows, 1))
     result = {
         "metric": ("boosting_iters_per_sec "
                    f"({shape_tag} nl={NUM_LEAVES} mb={MAX_BIN}; "
-                   f"holdout_auc={auc:.4f}; binning_s={bin_time:.1f}"
-                   f"{extras})"),
-        "value": round(iters_per_sec, 4),
+                   f"holdout_auc={auc:.4f}@{args.warmup + args.iters}"
+                   f"rounds; binning_s={bin_time:.1f}{extras})"),
+        "value": round(ips, 4),
         "unit": "iters/sec",
-        "vs_baseline": round(
-            iters_per_sec / CPU_LIGHTGBM_BASELINE_ITERS_PER_SEC, 4),
+        "vs_baseline": round(ips / base, 4),
     }
     print(json.dumps(result))
 
