@@ -38,6 +38,10 @@ def __getattr__(name):
         if name in ("init_multihost", "is_multihost"):
             from .parallel import multihost as _mh
             return getattr(_mh, name)
+        if name in ("train_distributed", "run_worker", "ShardSpec",
+                    "sync_bin_mappers"):
+            from .parallel import launch as _la
+            return getattr(_la, name)
     except ImportError as e:
         raise AttributeError(
             f"module 'lightgbm_tpu' has no attribute {name!r}: {e}") from e

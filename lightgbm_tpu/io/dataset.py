@@ -214,6 +214,20 @@ class Dataset:
             self.used_features = ref.used_features
             self.feature_names = ref.feature_names
             self.categorical_idx = ref.categorical_idx
+        elif self.bin_mappers:
+            # pre-injected mappers (the distributed bin-boundary sync:
+            # parallel/launch.py builds identical mappers on every
+            # process from an all-gathered sample, the TPU-native
+            # analog of DatasetLoader's distributed bin sync —
+            # dataset_loader.cpp, UNVERIFIED)
+            if len(self.bin_mappers) != self.num_total_features:
+                log.fatal(
+                    f"preset bin_mappers cover {len(self.bin_mappers)} "
+                    f"features but the data has "
+                    f"{self.num_total_features}")
+            self.used_features = [i for i, m
+                                  in enumerate(self.bin_mappers)
+                                  if not m.is_trivial]
         else:
             from ..config import coerce_bool
             p = self.params

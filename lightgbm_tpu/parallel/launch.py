@@ -1,0 +1,268 @@
+"""Distributed training launcher — the ``dask.py`` analog.
+
+Reference: ``python-package/lightgbm/dask.py`` (UNVERIFIED — empty
+mount, see SURVEY.md banner) automates the multi-worker story: align
+data partitions to workers, wire up ``machines``/ports, launch
+concurrent per-worker training, return the (identical) model from
+worker 0. Its transport is the socket collective layer.
+
+TPU-native redesign: ``jax.distributed`` is the cluster fabric and the
+SPMD learners already speak mesh collectives, so the launcher's job
+collapses to three things this module provides:
+
+1. :func:`train_distributed` — fork/join N localhost processes (the
+   in-box testing + single-host-multi-process story; a real pod runs
+   one process per host with the same worker body via
+   :func:`run_worker`);
+2. **automatic bin-boundary sync** — every process samples its own
+   row shard, the samples are all-gathered
+   (``multihost_utils.process_allgather``) and every process builds
+   IDENTICAL BinMappers from the union sample (the reference
+   ``DatasetLoader``'s distributed sample sync, dataset_loader.cpp —
+   UNVERIFIED). No rank-0 broadcast needed: same bytes in, same
+   mappers out, deterministically;
+3. model collection from rank 0.
+
+Pod recipe (multi-host hardware): run YOUR script once per host;
+in it call ``run_worker(rank=None, ...)`` (auto-discovery on TPU
+pods) or pass coordinator/rank explicitly. ``train_distributed``
+itself is the localhost many-process convenience wrapper around it.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import socket
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..utils import log
+from ..utils.log import LightGBMError
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@dataclass
+class ShardSpec:
+    """What ``data_fn`` returns: this process's row shard."""
+
+    data: np.ndarray                      # [n_local, F] raw features
+    label: Optional[np.ndarray] = None
+    weight: Optional[np.ndarray] = None
+    group: Optional[np.ndarray] = None
+    init_score: Optional[np.ndarray] = None
+
+
+def sync_bin_mappers(X_local: np.ndarray, params: Dict,
+                     categorical_idx=None):
+    """Distributed bin-boundary sync: identical BinMappers on every
+    process, built from an all-gathered cross-process sample.
+
+    Each process samples up to ``bin_construct_sample_cnt /
+    process_count`` rows of its shard (deterministic seed), the
+    fixed-size padded samples ride one ``process_allgather``, and each
+    process runs the same binning code on the same union sample —
+    bit-identical mappers with no broadcast step.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    from ..config import coerce_bool
+    from ..io.binning import find_bin_mappers
+
+    p = params
+    total_cnt = int(p.get("bin_construct_sample_cnt", 200000))
+    nproc = jax.process_count()
+    per = max(1, total_cnt // max(nproc, 1))
+    n_local, F = X_local.shape
+    rng = np.random.default_rng(
+        int(p.get("data_random_seed", 1)) + 7919 * jax.process_index())
+    k = min(per, n_local)
+    idx = (rng.choice(n_local, size=k, replace=False) if k < n_local
+           else np.arange(n_local))
+    # two allgathers: the tiny counts first, so the sample slot is
+    # sized by the LARGEST actual shard sample, not by the nominal
+    # bin_construct_sample_cnt (which would ship mostly-NaN padding
+    # when shards are small)
+    cnt = np.zeros((1,), np.int32) + k
+    g_cnt = np.asarray(multihost_utils.process_allgather(cnt)) \
+        .reshape(nproc)
+    slot = max(1, int(g_cnt.max()))
+    samp = np.full((slot, F), np.nan, np.float64)
+    samp[:k] = np.asarray(X_local, np.float64)[idx]
+    g_samp = np.asarray(multihost_utils.process_allgather(samp)) \
+        .reshape(nproc, slot, F)
+    union = np.concatenate([g_samp[r, :g_cnt[r]] for r in range(nproc)])
+    # total_sample_cnt semantics: the union IS the sample; sparse
+    # implicit-zero accounting applies within it only
+    from ..io.binning import load_forced_bins
+    return find_bin_mappers(
+        union,
+        max_bin=int(p.get("max_bin", 255)),
+        min_data_in_bin=int(p.get("min_data_in_bin", 3)),
+        sample_cnt=len(union),
+        use_missing=coerce_bool(p.get("use_missing", True)),
+        zero_as_missing=coerce_bool(p.get("zero_as_missing", False)),
+        categorical_features=categorical_idx,
+        max_bin_by_feature=p.get("max_bin_by_feature"),
+        seed=int(p.get("data_random_seed", 1)),
+        forced_bins=(load_forced_bins(str(p["forcedbins_filename"]))
+                     if p.get("forcedbins_filename") else None))
+
+
+def run_worker(params: Dict, data_fn: Callable[[int, int], ShardSpec],
+               num_boost_round: int = 100, *,
+               rank: Optional[int] = None,
+               num_processes: Optional[int] = None,
+               coordinator: Optional[str] = None,
+               platform: Optional[str] = None,
+               categorical_feature="auto"):
+    """The per-process worker body (call once per host on a pod).
+
+    Joins the ``jax.distributed`` job, fetches this process's shard
+    from ``data_fn(rank, num_processes)``, syncs bin boundaries across
+    all processes, trains the data-parallel learner, and returns the
+    Booster (identical on every rank — the SPMD program IS the sync).
+    """
+    import jax
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    from .multihost import init_multihost
+    if rank is not None or coordinator is not None:
+        init_multihost(coordinator, num_processes, rank)
+    else:
+        init_multihost()    # TPU pod auto-discovery
+
+    import lightgbm_tpu as lgb
+
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    shard = data_fn(rank, nproc)
+    if not isinstance(shard, ShardSpec):
+        shard = ShardSpec(**shard) if isinstance(shard, dict) \
+            else ShardSpec(*shard)
+    params = dict(params)
+    params.setdefault("tree_learner", "data")
+    ds = lgb.Dataset(shard.data, label=shard.label,
+                     weight=shard.weight, group=shard.group,
+                     init_score=shard.init_score,
+                     params=dict(params),
+                     categorical_feature=categorical_feature)
+    # automatic bin-boundary sync (closes the manual mapper-sharing
+    # contract multihost.py documented through round 3)
+    cat_idx = ds._resolve_categorical(
+        ds._resolve_feature_names(shard.data.shape[1]))
+    ds.bin_mappers = sync_bin_mappers(shard.data, params, cat_idx)
+    return lgb.train(params, ds, num_boost_round=num_boost_round)
+
+
+def _spawn_main(rank, nproc, port, params, data_fn, num_boost_round,
+                platform, categorical_feature, queue):
+    try:
+        # children inherit the parent's env; a fake-device-count flag
+        # (e.g. the test suite's 8-device CPU mesh) would multiply the
+        # world size — each localhost worker gets ONE device
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" in flags:
+            os.environ["XLA_FLAGS"] = " ".join(
+                f for f in flags.split()
+                if "host_platform_device_count" not in f)
+        bst = run_worker(params, data_fn, num_boost_round, rank=rank,
+                         num_processes=nproc,
+                         coordinator=f"localhost:{port}",
+                         platform=platform,
+                         categorical_feature=categorical_feature)
+        if rank == 0:
+            queue.put(("ok", bst.model_to_string()))
+    except Exception as e:          # surface the real worker error
+        import traceback
+        queue.put(("err", f"rank {rank}: {e}\n"
+                   f"{traceback.format_exc()}"))
+        raise
+
+
+def train_distributed(params: Dict,
+                      data_fn: Callable[[int, int], ShardSpec],
+                      n_processes: int, num_boost_round: int = 100, *,
+                      platform: Optional[str] = "cpu",
+                      categorical_feature="auto",
+                      timeout: float = 900.0):
+    """Train over ``n_processes`` localhost processes and return the
+    rank-0 Booster (the dask.py ``_train`` analog).
+
+    Args:
+      params: lightgbm params (``tree_learner`` defaults to ``data``).
+      data_fn: module-level picklable callable ``(rank, n_processes) ->
+        ShardSpec`` (or dict of its fields) producing each process's
+        row shard — the partition→worker alignment step.
+      n_processes: localhost world size (one CPU device each by
+        default; on real multi-host hardware run one process per host
+        yourself via :func:`run_worker` instead).
+      platform: force a JAX platform in the workers ("cpu" default —
+        this environment exposes one TPU chip, which cannot be shared
+        by N processes; pass None on a real pod).
+      timeout: seconds to wait for the workers.
+    """
+    ctx = mp.get_context("spawn")     # fork would inherit JAX state
+    port = _free_port()
+    queue = ctx.Queue()
+    procs = [ctx.Process(
+        target=_spawn_main,
+        args=(r, n_processes, port, params, data_fn, num_boost_round,
+              platform, categorical_feature, queue))
+        for r in range(n_processes)]
+    for p in procs:
+        p.start()
+    # poll: fail FAST when a worker dies before rank 0 reports (e.g. a
+    # non-importable data_fn under spawn) instead of sitting out the
+    # full timeout — the dask.py analog of surfacing worker loss
+    import queue as _queue
+    import time as _time
+    result = None
+    deadline = _time.monotonic() + timeout
+    while result is None and _time.monotonic() < deadline:
+        try:
+            result = queue.get(timeout=2.0)
+        except _queue.Empty:
+            dead = [(i, p.exitcode) for i, p in enumerate(procs)
+                    if not p.is_alive() and p.exitcode not in (0, None)]
+            if dead:
+                break
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    if result is None:
+        # a dying worker may have flushed its ('err', traceback) into
+        # the queue between our last poll and the liveness check —
+        # prefer that real error over the generic message
+        try:
+            result = queue.get_nowait()
+        except Exception:
+            pass
+    if result is None:
+        dead = [(i, p.exitcode) for i, p in enumerate(procs)
+                if p.exitcode not in (0, None)]
+        raise LightGBMError(
+            "distributed training produced no result "
+            + (f"(worker ranks/exitcodes {dead} died — is data_fn a "
+               f"module-level importable callable? spawn re-imports "
+               f"its module in each worker)" if dead else
+               "(workers timed out before rank 0 reported; re-run "
+               "with verbosity>=1 for worker logs)"))
+    status, payload = result
+    if status != "ok":
+        raise LightGBMError(f"distributed worker failed: {payload}")
+    import lightgbm_tpu as lgb
+    bst = lgb.Booster(model_str=payload)
+    log.info(f"distributed training done: {n_processes} processes, "
+             f"{bst.num_trees()} trees collected from rank 0")
+    return bst

@@ -13,16 +13,17 @@ layer (``parallel.mesh.put``) then assembles global arrays from
 per-process local chunks via ``jax.make_array_from_process_local_data``:
 each process constructs its ``Dataset`` from its OWN row shard (the
 reference's rank-aware ``pre_partition`` load, dataset_loader.cpp), and
-the SPMD learners consume the resulting global arrays. NOTE: binning
-must be consistent across processes — share the bin mappers (e.g.
-``Dataset.save_binary`` on rank 0, or identical
-``bin_construct_sample_cnt`` sampling of a common sample file).
+the SPMD learners consume the resulting global arrays. Cross-process
+bin-boundary consistency is AUTOMATIC through the launcher layer
+(``parallel.launch``: union-sample ``sync_bin_mappers``); hand-wired
+jobs can still share mappers manually (``Dataset.save_binary`` on rank
+0, or a ``reference=`` dataset).
 
-Validated by a REAL 2-process localhost run in CI
-(tests/test_multihost.py): two processes join one ``jax.distributed``
-job on the CPU backend, each ingests its own row shard binned against a
-shared reference dataset, trains ``tree_learner=data``, and the model
-matches a single-process run on the same global data. Mean-statistic
+Validated by a REAL 4-process localhost run in CI
+(tests/test_multihost.py): four processes join one ``jax.distributed``
+job on the CPU backend via ``train_distributed``, each ingests its own
+row shard with synced bin mappers, trains ``tree_learner=data``, and
+the model matches a single-process run on the same global data. Mean-statistic
 init scores (L2/binary/poisson family) sync across processes like the
 reference's ``Network::GlobalSyncUpByMean`` (boosting/gbdt.py);
 percentile-based init scores warn and use the local shard.

