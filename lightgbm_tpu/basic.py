@@ -153,7 +153,8 @@ class Booster:
                              if self.best_iteration > 0 else -1)
         es_kwargs = {k: _kwargs[k] for k in
                      ("pred_early_stop", "pred_early_stop_freq",
-                      "pred_early_stop_margin") if k in _kwargs}
+                      "pred_early_stop_margin", "contrib_force_f64")
+                     if k in _kwargs}
         if self._from_model is not None:
             return self._from_model.predict(
                 data, raw_score=raw_score, start_iteration=start_iteration,

@@ -379,6 +379,29 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
     return mappers
 
 
+def mappers_from_params(X, params: Dict, categorical_idx=None,
+                        sample_cnt=None) -> List["BinMapper"]:
+    """The ONE params -> ``find_bin_mappers`` marshaling point, shared
+    by ``Dataset.construct`` and the distributed bin-boundary sync
+    (``parallel.launch.sync_bin_mappers``) so both paths can never
+    drift on a binning parameter."""
+    from ..config import coerce_bool
+    p = params
+    return find_bin_mappers(
+        X,
+        max_bin=int(p.get("max_bin", 255)),
+        min_data_in_bin=int(p.get("min_data_in_bin", 3)),
+        sample_cnt=(int(p.get("bin_construct_sample_cnt", 200000))
+                    if sample_cnt is None else sample_cnt),
+        use_missing=coerce_bool(p.get("use_missing", True)),
+        zero_as_missing=coerce_bool(p.get("zero_as_missing", False)),
+        categorical_features=categorical_idx,
+        max_bin_by_feature=p.get("max_bin_by_feature"),
+        seed=int(p.get("data_random_seed", 1)),
+        forced_bins=(load_forced_bins(str(p["forcedbins_filename"]))
+                     if p.get("forcedbins_filename") else None))
+
+
 def load_forced_bins(path: str) -> Dict[int, List[float]]:
     """Parse a forcedbins_filename JSON file: a list of
     ``{"feature": i, "bin_upper_bound": [...]}`` entries (upstream

@@ -229,22 +229,9 @@ class Dataset:
                                   in enumerate(self.bin_mappers)
                                   if not m.is_trivial]
         else:
-            from ..config import coerce_bool
-            p = self.params
-            self.bin_mappers = find_bin_mappers(
-                X,
-                max_bin=int(p.get("max_bin", 255)),
-                min_data_in_bin=int(p.get("min_data_in_bin", 3)),
-                sample_cnt=int(p.get("bin_construct_sample_cnt", 200000)),
-                use_missing=coerce_bool(p.get("use_missing", True)),
-                zero_as_missing=coerce_bool(p.get("zero_as_missing",
-                                                  False)),
-                categorical_features=cat_idx,
-                max_bin_by_feature=p.get("max_bin_by_feature"),
-                seed=int(p.get("data_random_seed", 1)),
-                forced_bins=(load_forced_bins(
-                    str(p["forcedbins_filename"]))
-                    if p.get("forcedbins_filename") else None))
+            from .binning import mappers_from_params
+            self.bin_mappers = mappers_from_params(
+                X, self.params, categorical_idx=cat_idx)
             self.used_features = [i for i, m in enumerate(self.bin_mappers)
                                   if not m.is_trivial]
             if len(self.used_features) < self.num_total_features:

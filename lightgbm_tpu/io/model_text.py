@@ -143,7 +143,8 @@ class HostModel:
                 pred_contrib: bool = False,
                 pred_early_stop: bool = False,
                 pred_early_stop_freq: int = 10,
-                pred_early_stop_margin: float = 10.0) -> np.ndarray:
+                pred_early_stop_margin: float = 10.0,
+                contrib_force_f64=None) -> np.ndarray:
         from .dataset import Dataset as _DS
         if hasattr(data, "tocsr") and not isinstance(data, np.ndarray) \
                 and data.shape[0] > 0:
@@ -160,7 +161,8 @@ class HostModel:
                         pred_leaf=pred_leaf, pred_contrib=pred_contrib,
                         pred_early_stop=pred_early_stop,
                         pred_early_stop_freq=pred_early_stop_freq,
-                        pred_early_stop_margin=pred_early_stop_margin)
+                        pred_early_stop_margin=pred_early_stop_margin,
+                        contrib_force_f64=contrib_force_f64)
                     for i in range(0, csr.shape[0], chunk)]
             return np.concatenate(outs, axis=0)
         X = _DS._to_matrix(data)
@@ -179,7 +181,8 @@ class HostModel:
                 out[:, i] = t.predict_leaf_raw(X)
             return out
         if pred_contrib:
-            return self._predict_contrib(X, use, K)
+            return self._predict_contrib(X, use, K,
+                                         force_f64=contrib_force_f64)
         raw = np.zeros((n, K), dtype=np.float64)
         obj0 = self.objective_str.split(" ")[0]
         early = (pred_early_stop and not self.average_output
@@ -235,8 +238,8 @@ class HostModel:
             return np.sign(r) * r * r
         return raw[:, 0] if raw.shape[1] == 1 else raw
 
-    def _predict_contrib(self, X, trees, K):
-        from ..ops.shap import tree_shap_batch
+    def _predict_contrib(self, X, trees, K, force_f64=None):
+        from ..ops.shap import forest_shap_batch
         if any(getattr(t, "is_linear", False) for t in trees):
             # the reference likewise refuses SHAP for linear trees —
             # constant-leaf attributions would not sum to the prediction
@@ -244,9 +247,8 @@ class HostModel:
                       "models")
         n = X.shape[0]
         n_feat = self.max_feature_idx + 1
-        out = np.zeros((n, K, n_feat + 1), dtype=np.float64)
-        for i, t in enumerate(trees):
-            out[:, i % K, :] += tree_shap_batch(t, X, n_feat)
+        out = forest_shap_batch(trees, X, n_feat, K=K,
+                                force_f64=force_f64)
         if self.average_output and len(trees):
             # RF: contributions average like the prediction does, keeping
             # the SHAP local-accuracy invariant sum(contrib) == raw pred

@@ -74,8 +74,7 @@ def sync_bin_mappers(X_local: np.ndarray, params: Dict,
     import jax
     from jax.experimental import multihost_utils
 
-    from ..config import coerce_bool
-    from ..io.binning import find_bin_mappers
+    from ..io.binning import mappers_from_params
 
     p = params
     total_cnt = int(p.get("bin_construct_sample_cnt", 200000))
@@ -102,19 +101,8 @@ def sync_bin_mappers(X_local: np.ndarray, params: Dict,
     union = np.concatenate([g_samp[r, :g_cnt[r]] for r in range(nproc)])
     # total_sample_cnt semantics: the union IS the sample; sparse
     # implicit-zero accounting applies within it only
-    from ..io.binning import load_forced_bins
-    return find_bin_mappers(
-        union,
-        max_bin=int(p.get("max_bin", 255)),
-        min_data_in_bin=int(p.get("min_data_in_bin", 3)),
-        sample_cnt=len(union),
-        use_missing=coerce_bool(p.get("use_missing", True)),
-        zero_as_missing=coerce_bool(p.get("zero_as_missing", False)),
-        categorical_features=categorical_idx,
-        max_bin_by_feature=p.get("max_bin_by_feature"),
-        seed=int(p.get("data_random_seed", 1)),
-        forced_bins=(load_forced_bins(str(p["forcedbins_filename"]))
-                     if p.get("forcedbins_filename") else None))
+    return mappers_from_params(union, p, categorical_idx=categorical_idx,
+                               sample_cnt=len(union))
 
 
 def run_worker(params: Dict, data_fn: Callable[[int, int], ShardSpec],

@@ -18,6 +18,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))          # repo root -> lightgbm_tpu
 
 
+# single source of truth for the multihost tests (test_multihost.py
+# imports both, so the worker baseline and the launcher run can never
+# drift onto different data/configs)
+PARAMS = {"objective": "binary", "num_leaves": 15,
+          "min_data_in_leaf": 20, "verbosity": -1,
+          "tree_learner": "data", "tpu_double_precision_hist": True}
+
+
 def make_data():
     import numpy as np
     rng = np.random.default_rng(0)
@@ -44,9 +52,7 @@ def main():
     import lightgbm_tpu as lgb
 
     X, y = make_data()
-    params = {"objective": "binary", "num_leaves": 15,
-              "min_data_in_leaf": 20, "verbosity": -1,
-              "tree_learner": "data", "tpu_double_precision_hist": True}
+    params = dict(PARAMS)
 
     if rank >= 0:
         # consistent binning across processes: every process builds the

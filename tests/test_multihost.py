@@ -23,18 +23,9 @@ import lightgbm_tpu as lgb
 
 WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
 
-PARAMS = {"objective": "binary", "num_leaves": 15,
-          "min_data_in_leaf": 20, "verbosity": -1,
-          "tree_learner": "data", "tpu_double_precision_hist": True}
-
-
-def make_data():
-    rng = np.random.default_rng(0)
-    n, f = 4096, 8
-    X = rng.normal(size=(n, f))
-    y = (X[:, 0] + 0.5 * X[:, 1]
-         + rng.normal(scale=0.3, size=n) > 0).astype(float)
-    return X, y
+# data + params shared with the subprocess baseline (single source of
+# truth — a drifted copy would compare models from different setups)
+from _multihost_worker import PARAMS, make_data  # noqa: E402
 
 
 def shard_fn(rank, nproc):
