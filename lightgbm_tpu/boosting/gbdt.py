@@ -34,6 +34,26 @@ from ..tree import Tree
 from ..utils import log
 
 
+import functools as _ft
+
+
+@_ft.partial(jax.jit, donate_argnums=(0,))
+def _cegb_u_update_j(U, leaf_ids, pf):
+    """U |= path-features of each row's leaf, per class tree: one-hot
+    [n, L] x [L, F] matmuls (0/1 exact in bf16, f32 accumulation)."""
+    K, L, F = pf.shape
+    for k in range(K):
+        oh = (leaf_ids[k][:, None]
+              == jnp.arange(L, dtype=jnp.int32)[None, :]
+              ).astype(jnp.bfloat16)
+        hit = jax.lax.dot_general(
+            oh, pf[k].astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        U = U | (hit > 0.5)
+    return U
+
+
 def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
@@ -349,15 +369,21 @@ class GBDT:
 
         # CEGB (cost_effective_gradient_boosting.hpp): split penalty +
         # coupled per-feature penalty charged until a feature first
-        # enters the model (host-tracked, device array refreshed on use)
+        # enters the model (host-tracked, device array refreshed on
+        # use) + LAZY per-row penalty (round 4): splitting leaf l on f
+        # costs lazy[f] x (#rows in l that never met f on a tree path
+        # yet) — the per-row feature-acquisition model. Acquisition
+        # state is a device [n_pad, F_pad] matrix updated after each
+        # tree from the per-leaf path-feature sets.
         coupled = list(config.cegb_penalty_feature_coupled or [])
-        # (cegb_penalty_feature_lazy warns centrally in config.py's
-        # UNIMPLEMENTED_PARAMS table)
+        lazy = list(config.cegb_penalty_feature_lazy or [])
         self.has_cegb = bool(
-            config.cegb_penalty_split > 0 or any(coupled))
+            config.cegb_penalty_split > 0 or any(coupled) or any(lazy))
         self._cegb_coupled = None
         self._cegb_used = None
         self._cegb_pen_cache = None
+        self._cegb_lazy = None
+        self._cegb_U = None     # device [n_pad, F_pad] bool, lazy init
         if self.has_cegb and coupled:
             arr = np.zeros(self.F_pad, dtype=np.float32)
             for i, f in enumerate(self.train_set.used_features):
@@ -365,6 +391,18 @@ class GBDT:
                     arr[i] = float(coupled[f])
             self._cegb_coupled = arr * float(config.cegb_tradeoff)
             self._cegb_used = np.zeros(self.F_pad, dtype=bool)
+        if self.has_cegb and any(lazy):
+            if (self.mesh is not None or self.has_bundles
+                    or getattr(self.objective, "has_pos_state", False)):
+                log.fatal("cegb_penalty_feature_lazy requires the "
+                          "serial single-device learner without EFB "
+                          "bundling or position-state objectives")
+            arr = np.zeros(self.F_pad, dtype=np.float32)
+            for i, f in enumerate(self.train_set.used_features):
+                if f < len(lazy):
+                    arr[i] = float(lazy[f])
+            self._cegb_lazy = jnp.asarray(
+                arr * float(config.cegb_tradeoff))
 
         # ---- forced splits (forcedsplits_filename; ForceSplits in
         # serial_tree_learner.cpp — UNVERIFIED): JSON tree flattened
@@ -569,16 +607,20 @@ class GBDT:
     def _load_forced_splits(self, path: str) -> None:
         """Parse a forcedsplits_filename JSON tree ({"feature",
         "threshold", nested "left"/"right"}) into the preorder table
-        grow_tree consumes. Entries on unused/categorical features are
-        skipped with their subtrees, like the reference's validity
-        checks."""
+        grow_tree consumes. Numerical thresholds map to bin ids;
+        CATEGORICAL entries (round 4) take "threshold" as a category
+        value or list of values, binned into a goes-left bitset.
+        Entries on unused features are skipped with their subtrees,
+        like the reference's validity checks."""
         import json
         from ..io.binning import BIN_TYPE_CATEGORICAL
         with open(path) as f:
             spec = json.load(f)
         orig_to_used = {f: i for i, f in
                         enumerate(self.train_set.used_features)}
-        parents, lefts, feats, tbins = [], [], [], []
+        W = (self.B + 31) // 32
+        parents, lefts, feats, tbins, iscat, bitsets = \
+            [], [], [], [], [], []
 
         def walk(node, parent_idx, is_left):
             if not isinstance(node, dict) or "feature" not in node:
@@ -591,32 +633,61 @@ class GBDT:
                 log.warning(f"forced split on unused feature {fo} "
                             f"skipped (with its subtree)")
                 return
-            if mapper.bin_type == BIN_TYPE_CATEGORICAL:
-                log.warning(f"forced split on categorical feature {fo} "
-                            f"is not supported; skipped (with its "
-                            f"subtree)")
-                return
             if len(parents) >= self.config.num_leaves - 1:
                 log.warning("more forced splits than num_leaves-1; "
                             "extra entries ignored")
                 return
-            tb = mapper.value_to_bin(float(node["threshold"]))
+            bits = np.zeros(W, np.uint32)
+            if mapper.bin_type == BIN_TYPE_CATEGORICAL:
+                thr = node["threshold"]
+                cats = thr if isinstance(thr, (list, tuple)) else [thr]
+                hit = 0
+                for cv in cats:
+                    b = (mapper.cat_to_bin or {}).get(int(cv))
+                    if b is None:
+                        log.warning(f"forced categorical split: "
+                                    f"category {cv} of feature {fo} "
+                                    f"was not seen at bin time; "
+                                    f"ignored")
+                        continue
+                    bits[b >> 5] |= np.uint32(1) << np.uint32(b & 31)
+                    hit += 1
+                if hit == 0:
+                    log.warning(f"forced categorical split on feature "
+                                f"{fo} matched no known category; "
+                                f"skipped (with its subtree)")
+                    return
+                tb = 0
+                cat = True
+            else:
+                tb = mapper.value_to_bin(float(node["threshold"]))
+                cat = False
             idx = len(parents)
             parents.append(parent_idx)
             lefts.append(bool(is_left))
             feats.append(u)
             tbins.append(tb)
+            iscat.append(cat)
+            bitsets.append(bits)
             walk(node.get("left"), idx, True)
             walk(node.get("right"), idx, False)
 
         walk(spec, -1, False)
         if parents:
+            if any(iscat) and not self.has_categorical:
+                # cannot happen via normal construction (cat mappers
+                # imply has_categorical), but guard the invariant the
+                # learner's bitset lanes rely on
+                log.fatal("forced categorical splits require a dataset "
+                          "with categorical features")
             self._n_forced = len(parents)
             self._forced_dev = (
                 jnp.asarray(np.asarray(parents, np.int32)),
                 jnp.asarray(np.asarray(lefts, bool)),
                 jnp.asarray(np.asarray(feats, np.int32)),
-                jnp.asarray(np.asarray(tbins, np.int32)))
+                jnp.asarray(np.asarray(tbins, np.int32)),
+                jnp.asarray(np.asarray(iscat, bool)),
+                jnp.asarray(np.stack(bitsets)))
             log.info(f"applying {self._n_forced} forced split(s) at "
                      f"the top of every tree")
 
@@ -669,6 +740,9 @@ class GBDT:
             monotone_intermediate=(
                 str(config.monotone_constraints_method).lower()
                 in ("intermediate", "advanced")),
+            monotone_advanced=(
+                str(config.monotone_constraints_method).lower()
+                == "advanced"),
             monotone_penalty=config.monotone_penalty,
             has_interaction=self.has_interaction,
             has_bundles=self.has_bundles,
@@ -677,6 +751,7 @@ class GBDT:
             has_cegb=self.has_cegb,
             cegb_tradeoff=config.cegb_tradeoff,
             cegb_penalty_split=config.cegb_penalty_split,
+            has_cegb_lazy=self._cegb_lazy is not None,
             path_smooth=config.path_smooth,
             extra_trees=config.extra_trees,
             extra_seed=config.extra_seed,
@@ -760,7 +835,7 @@ class GBDT:
             return tree["leaf_value"][leaf_id] * lr
 
         def grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                     allowed, qkey=None, cegb_pen=None):
+                     allowed, qkey=None, cegb_pen=None, cegb_U=None):
             trees, leaf_ids = [], []
             new_score = score
             for k in range(K):
@@ -786,7 +861,9 @@ class GBDT:
                     node_key=(None if qkey is None
                               else jax.random.fold_in(qkey, 0xB14D + k)),
                     cegb_pen=cegb_pen, contri=self.feat_contri,
-                    forced=self._forced_dev)
+                    forced=self._forced_dev,
+                    lazy=(None if cegb_U is None
+                          else (cegb_U, self._cegb_lazy)))
                 if use_quant and renew_quant:
                     # re-derive leaf outputs from FULL-precision sums
                     # (quant_train_renew_leaf)
@@ -816,11 +893,11 @@ class GBDT:
             return stacked, jnp.stack(leaf_ids), new_score
 
         def step_impl(bins, bins_t, label, weight, score, mask_gh,
-                      mask_count, allowed, cegb_pen, key):
+                      mask_count, allowed, cegb_pen, key, cegb_U=None):
             g, h = gradients(score, label, weight, key)
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
                             allowed, qkey=jax.random.fold_in(key, 0x9e37),
-                            cegb_pen=cegb_pen)
+                            cegb_pen=cegb_pen, cegb_U=cegb_U)
 
         # ---- tpu_debug: checkify validation pass (SURVEY.md §5) --------
         # a separate jitted checkify program (cheap: gradients only, no
@@ -971,18 +1048,20 @@ class GBDT:
             return mask_gh, mask_count
 
         def step_goss_impl(bins, bins_t, label, weight, score, valid_mask,
-                           allowed, cegb_pen, key):
+                           allowed, cegb_pen, key, cegb_U=None):
             kg, km = jax.random.split(key)
             g, h = gradients(score, label, weight, kg)
             mask_gh, mask_count = goss_masks(g, h, valid_mask, km)
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
                             allowed, qkey=jax.random.fold_in(key, 0x9e37),
-                            cegb_pen=cegb_pen)
+                            cegb_pen=cegb_pen, cegb_U=cegb_U)
 
         def step_custom_impl(bins, bins_t, score, g, h, mask_gh,
-                             mask_count, allowed, cegb_pen, key):
+                             mask_count, allowed, cegb_pen, key,
+                             cegb_U=None):
             return grow_all(bins, bins_t, score, g, h, mask_gh, mask_count,
-                            allowed, qkey=key, cegb_pen=cegb_pen)
+                            allowed, qkey=key, cegb_pen=cegb_pen,
+                            cegb_U=cegb_U)
 
         # ---- GOSS histogram-only compaction (tpu_goss_compact) ---------
         # The masked formulation scans ALL rows with zero weights; the
@@ -1046,7 +1125,7 @@ class GBDT:
 
             def step_goss_compact_impl(bins, bins_t, label, weight,
                                        valid_mask, score, allowed,
-                                       cegb_pen, key):
+                                       cegb_pen, key, cegb_U=None):
                 kg, km = jax.random.split(key)
                 g, h = gradients(score, label, weight, kg)
                 mask_gh, mask_count = goss_masks(g, h, valid_mask, km)
@@ -1103,7 +1182,9 @@ class GBDT:
                         node_key=jax.random.fold_in(qkey, 0xB14D + k),
                         cegb_pen=cegb_pen, contri=self.feat_contri,
                         compact=(bins_c, bins_t_c, vals_c),
-                        forced=self._forced_dev)
+                        forced=self._forced_dev,
+                        lazy=(None if cegb_U is None
+                              else (cegb_U, self._cegb_lazy)))
                     # FULL leaf ids came from the in-loop partition; the
                     # score update is the same one-hot matmul as the
                     # masked path (no per-row traversal)
@@ -1119,7 +1200,8 @@ class GBDT:
             def _step_goss_compact(score, allowed, cegb_pen, key):
                 return _compact_j(dd.bins, dd.bins_t, dd.label,
                                   dd.weight, dd.valid_mask, score,
-                                  allowed, cegb_pen, key)
+                                  allowed, cegb_pen, key,
+                                  self._cegb_U_arg())
 
             self._step_goss_compact = _step_goss_compact
         else:
@@ -1164,18 +1246,18 @@ class GBDT:
             def step(score, mask_gh, mask_count, allowed, cegb_pen, key):
                 return _step_j(d.bins, d.bins_t, d.label, d.weight, score,
                                mask_gh, mask_count, allowed, cegb_pen,
-                               key)
+                               key, self._cegb_U_arg())
 
             def step_goss(score, allowed, cegb_pen, key):
                 return _goss_j(d.bins, d.bins_t, d.label, d.weight,
                                score, d.valid_mask, allowed, cegb_pen,
-                               key)
+                               key, self._cegb_U_arg())
 
             def step_custom(score, g, h, mask_gh, mask_count, allowed,
                             cegb_pen, key):
                 return _custom_j(d.bins, d.bins_t, score, g, h,
                                  mask_gh, mask_count, allowed, cegb_pen,
-                                 key)
+                                 key, self._cegb_U_arg())
 
             if getattr(obj, "has_pos_state", False):
                 # stateful objective: gradients also return updated
@@ -1399,6 +1481,46 @@ class GBDT:
         self._apply_renewed = apply_renewed
 
     # ------------------------------------------------------------------
+    def _cegb_U_arg(self) -> Optional[jnp.ndarray]:
+        """Device [n_pad, F_pad] per-row feature-acquisition matrix for
+        the lazy CEGB penalty; padding rows start fully acquired so
+        they never contribute penalty mass."""
+        if self._cegb_lazy is None:
+            return None
+        if self._cegb_U is None:
+            m = np.zeros((self.data.n_pad, self.F_pad), bool)
+            m[self.data.n:] = True
+            self._cegb_U = jnp.asarray(m)
+        return self._cegb_U
+
+    def _cegb_lazy_update(self, leaf_ids) -> None:
+        """After a tree lands: rows acquire every feature on their leaf
+        path (cost_effective_gradient_boosting.hpp marks
+        feature-used-in-data on split application)."""
+        K = self.num_class
+        L = self.config.num_leaves
+        pf = np.zeros((K, L, self.F_pad), bool)
+        for k in range(K):
+            t = self.models[-K + k]
+            if not t.num_nodes:
+                continue
+            # leaf path features via parent walk over the host tree
+            feats = np.asarray(t.split_feature[:t.num_nodes])
+            lc = np.asarray(t.left_child[:t.num_nodes])
+            rc = np.asarray(t.right_child[:t.num_nodes])
+
+            def walk(node, used):
+                if node < 0:
+                    pf[k, -node - 1, list(used)] = True
+                    return
+                u2 = used | {int(feats[node])}
+                walk(int(lc[node]), u2)
+                walk(int(rc[node]), u2)
+
+            walk(0, set())
+        self._cegb_U = _cegb_u_update_j(self._cegb_U, leaf_ids,
+                                        jnp.asarray(pf))
+
     def _cegb_pen(self) -> Optional[jnp.ndarray]:
         """Per-feature coupled CEGB penalty ([F_pad]); zero for features
         the model already uses. None when CEGB is off (the split-cost
@@ -1548,6 +1670,8 @@ class GBDT:
             self.valid_scores = self._valid_update(self.valid_scores,
                                                    stacked)
         self._append_host_trees(self._fetch_tree_arrays(stacked))
+        if self._cegb_lazy is not None:
+            self._cegb_lazy_update(leaf_ids)
         if self.linear_tree and grad is None:
             self._apply_linear_fit(leaf_ids, score_pre)
         if self.config.tpu_debug_checks:
@@ -1666,7 +1790,8 @@ class GBDT:
                             or c.neg_bagging_fraction < 1.0))
         return (self.fobj is None and not renews and not use_bagging
                 and c.feature_fraction >= 1.0 and not self.valid_data
-                and self._cegb_coupled is None and not self.linear_tree
+                and self._cegb_coupled is None
+                and self._cegb_lazy is None and not self.linear_tree
                 and not c.tpu_debug_checks and not c.tpu_debug
                 and self._pos_state is None)
 

@@ -318,9 +318,6 @@ _FALSE_STRINGS = {"false", "0", "f", "no", "n", "-", "off"}
 # table + source references cover the whole _PARAMS table).
 # name -> what's missing.
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
-    "cegb_penalty_feature_lazy":
-        "per-row feature-acquisition tracking; use "
-        "cegb_penalty_feature_coupled",
     "parser_config_file": "custom text-parser plugins are not supported",
 }
 _WARNED_PARAM_VALUES: set = set()
@@ -525,13 +522,6 @@ class Config:
         mcm = str(self.monotone_constraints_method).lower()
         if mcm not in ("basic", "intermediate", "advanced"):
             log.fatal(f"Unknown monotone_constraints_method {mcm!r}")
-        if mcm == "advanced" \
-                and ("monotone_advanced", mcm) not in _WARNED_PARAM_VALUES:
-            _WARNED_PARAM_VALUES.add(("monotone_advanced", mcm))
-            log.warning("monotone_constraints_method=advanced falls "
-                        "back to the intermediate method (the advanced "
-                        "slack-redistribution refinement is not "
-                        "implemented)")
         dev = str(self.device_type).lower()
         # cpu/gpu/cuda requests run on the TPU/XLA backend here
         if dev in ("cpu", "gpu", "cuda"):

@@ -104,6 +104,14 @@ class GrowConfig:
     # splits near the root
     has_monotone: bool = False
     monotone_intermediate: bool = False
+    # advanced mode (AdvancedLeafConstraints, monotone_constraints.hpp):
+    # intermediate's per-round bound recompute, but each node's bound
+    # aggregates only the opposing subtree's BOUNDARY-ADJACENT strip —
+    # leaves whose split-feature bin range touches the node's threshold
+    # — instead of the whole subtree (shielded leaves are ordered
+    # transitively through the strip chain). Tracked via per-leaf
+    # per-feature bin-range carries.
+    monotone_advanced: bool = False
     monotone_penalty: float = 0.0
     has_interaction: bool = False
     # EFB (dataset_loader.cpp FastFeatureBundling): bins is the bundled
@@ -121,6 +129,11 @@ class GrowConfig:
     has_cegb: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
+    # lazy per-row feature-acquisition penalty: grow_tree's `lazy`
+    # argument carries (U [n, F] acquired-matrix, penalty [F]); each
+    # candidate child's penalty is penalty[f] x #unacquired rows,
+    # counted with a membership-mask matmul per round
+    has_cegb_lazy: bool = False
     # path smoothing (feature_histogram.hpp USE_SMOOTHING): children
     # shrink toward the parent leaf's stored output by n/(n+alpha)
     path_smooth: float = 0.0
@@ -219,13 +232,17 @@ class GrowState(NamedTuple):
     # IntermediateLeafConstraints' recursive constraint walks
     mono_left: jnp.ndarray
     mono_right: jnp.ndarray
+    # advanced monotone mode: per-leaf per-feature bin ranges
+    # ([L+1, F_meta] when active, [1, 1] placeholders otherwise) — the
+    # adjacency test for strip-bounded constraints
+    leaf_flo: jnp.ndarray
+    leaf_fhi: jnp.ndarray
     # compact-row leaf ids for GOSS histogram-only compaction ([1]
     # placeholder otherwise): partitioned by the same splits as leaf_id
     leaf_id_c: jnp.ndarray
-    # forced-split machinery (placeholders when cfg.n_forced == 0):
-    # next forced entry to attempt, and each entry's realized target
-    # leaf slot (-1 pending parent, -2 cancelled by a skipped parent)
-    forced_ptr: jnp.ndarray
+    # forced-split machinery (placeholder when cfg.n_forced == 0):
+    # each entry's state: -1 waiting on parent, >=0 realized target
+    # leaf slot, -2 cancelled (skipped parent), -3 applied
     forced_target: jnp.ndarray
 
 
@@ -253,6 +270,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
               contri: jax.Array = None,
               compact: Tuple = None,
               forced: Tuple = None,
+              lazy: Tuple = None,
               ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Grow one tree.
 
@@ -447,8 +465,28 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             round_tag)
         return jax.random.uniform(kk, (C, F_meta))
 
+    if not cfg.has_cegb_lazy:
+        lazy = None
+    if lazy is not None:
+        lazy_U, lazy_pen = lazy
+        notU = (1.0 - lazy_U.astype(jnp.float32)).astype(jnp.bfloat16)
+
+        def lazy_pen2(child_ids, lid_vec):
+            """[C] candidate leaf ids -> [C, F] lazy penalties:
+            penalty[f] x #rows of the child that never acquired f
+            (0/1 bf16 operands, exact f32 accumulation)."""
+            mk = (lid_vec[:, None]
+                  == child_ids[None, :]).astype(jnp.bfloat16)  # [n, C]
+            cnt = jax.lax.dot_general(
+                mk, notU, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)            # [C, F]
+            return cnt * lazy_pen[None, :]
+    else:
+        lazy_pen2 = None
+
     def search_best(hists, sums, lowers=None, uppers=None, allows=None,
-                    parent_outs=None, round_tag=0, depths=None):
+                    parent_outs=None, round_tag=0, depths=None,
+                    pen2=None):
         """Best split per child: ``hists [C, F_h, B, 3]`` (mode-reduced),
         ``sums [C, 3]`` global leaf totals, optional per-child monotone
         output bounds (``[C]``), interaction-constrained allowed
@@ -517,14 +555,24 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         eu_s = (jax.lax.dynamic_slice_in_dim(eu, off, F_s, axis=1)
                 if eu is not None and (mode_scatter or mode_feature)
                 else eu)
-        best = jax.vmap(lambda h, s, al, lo, hi, po, eu_, dp:
+        # one penalty shape for both CEGB flavors: per-child lazy (+
+        # coupled), broadcast coupled, or None — a single vmap call
+        # (None vmaps as an empty pytree)
+        if pen2 is not None:
+            pen_c = pen2 + (cp_s[None, :] if cp_s is not None else 0.0)
+        elif cp_s is not None:
+            pen_c = jnp.broadcast_to(cp_s[None, :],
+                                     (hists.shape[0], cp_s.shape[0]))
+        else:
+            pen_c = None
+        best = jax.vmap(lambda h, s, al, lo, hi, po, eu_, dp, p2:
                         find_best_split(
                             h, s, nb_s, hn_s, al, scfg, is_cat=ic_s,
                             mono=mn_s, out_lower=lo, out_upper=hi,
-                            cegb_pen=cp_s, parent_out=po, extra_u=eu_,
+                            cegb_pen=p2, parent_out=po, extra_u=eu_,
                             contri=ct_s, depth=dp))(
-            hists, sums, allows_s, lowers, uppers, parent_outs, eu_s,
-            depths)
+            hists, sums, allows_s, lowers, uppers, parent_outs,
+            eu_s, depths, pen_c)
         best["feature"] = best["feature"] + off
         if mode_scatter:
             # SyncUpGlobalBestSplit across feature owners
@@ -538,16 +586,23 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                                 cfg.lambda_l2, cfg.max_delta_step)
 
     use_mono_inter = cfg.has_monotone and cfg.monotone_intermediate
+    use_mono_adv = use_mono_inter and cfg.monotone_advanced
 
     # forced splits (forcedsplits_filename; Tree::AddSplit forced paths
     # in serial_tree_learner.cpp ForceSplits — UNVERIFIED): a PREORDER
-    # table (parents before children) applied ONE entry per round
-    # before free growth. Requires the pool (leaf_hist) to derive the
-    # forced threshold's left sums; the engine gates eligibility.
+    # table (parents before children). Every READY entry (parent
+    # realized) is applied in the SAME leaf-batch round — sibling
+    # entries land together, so a k-entry table consumes ~depth(table)
+    # rounds, not k (round 4; was one-entry-per-round). Numerical AND
+    # categorical entries (one-vs-rest bin bitsets) are supported.
+    # forced_target codes: -1 waiting on parent, >=0 target leaf slot,
+    # -2 cancelled (skipped parent), -3 applied. Requires the pool
+    # (leaf_hist) for the forced threshold's child sums; the engine
+    # gates eligibility.
     if cfg.n_forced <= 0:
         forced = None
     if forced is not None:
-        f_parent, f_is_left, f_feat, f_tbin = forced
+        f_parent, f_is_left, f_feat, f_tbin, f_is_cat, f_bitset = forced
         M_f = cfg.n_forced
         assert not cfg.hist_rebuild, \
             "forced splits need the histogram pool"
@@ -582,7 +637,9 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             root_hist[None], root_sums[None], allows=root_allows,
             parent_outs=root_parent_out, round_tag=L + 7,
             depths=(jnp.zeros(1, i32)
-                    if cfg.monotone_penalty > 0.0 else None)))
+                    if cfg.monotone_penalty > 0.0 else None),
+            pen2=(lazy_pen2(jnp.zeros(1, i32), leaf_id0)
+                  if lazy is not None else None)))
 
     def set0(arr, value):
         return arr.at[0].set(value)
@@ -641,9 +698,13 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             (L, L + 1) if use_mono_inter else (1, 1), jnp.bool_),
         mono_right=jnp.zeros(
             (L, L + 1) if use_mono_inter else (1, 1), jnp.bool_),
+        leaf_flo=(jnp.zeros((L + 1, F_meta), i32) if use_mono_adv
+                  else jnp.zeros((1, 1), i32)),
+        leaf_fhi=(jnp.broadcast_to(feat_num_bin[None, :],
+                                   (L + 1, F_meta)).astype(i32)
+                  if use_mono_adv else jnp.zeros((1, 1), i32)),
         leaf_id_c=(leaf_id0_c if compact is not None
                    else jnp.zeros(1, i32)),
-        forced_ptr=jnp.zeros((), i32),
         forced_target=(jnp.where(f_parent < 0, 0, -1).astype(i32)
                        if forced is not None else jnp.zeros(1, i32)),
     )
@@ -658,46 +719,81 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         gains = _masked_gains(s.best_gain, s.leaf_depth, s.num_leaves,
                               cfg.max_depth)
         if forced is not None:
-            # ---- forced-split round (one table entry per round) ------
-            fp = s.forced_ptr
-            in_forced = fp < M_f
-            fpc = jnp.minimum(fp, M_f - 1)
-            f_tgt = s.forced_target[fpc]
-            ff_i = f_feat[fpc]
-            ftb_i = f_tbin[fpc]
-            tgt_c = jnp.clip(f_tgt, 0, L)
-            # the forced threshold's child sums from the pool histogram
-            # (missing-right semantics, dir 0 of the numerical scan)
-            hist_tf = jax.lax.dynamic_index_in_dim(
-                s.leaf_hist, tgt_c, axis=0, keepdims=False)   # [F,B,3]
-            col_f = jax.lax.dynamic_index_in_dim(
-                hist_tf, ff_i, axis=0, keepdims=False)        # [B,3]
-            bidx_f = jnp.arange(B, dtype=i32)
-            nanb_f = feat_has_nan[ff_i] \
-                & (bidx_f == feat_num_bin[ff_i] - 1)
-            lm_f = (bidx_f <= ftb_i) & ~nanb_f
-            f_lsums = jnp.sum(col_f * lm_f[:, None], axis=0)
-            f_psums = s.leaf_sums[tgt_c]
-            f_rsums = f_psums - f_lsums
-            # a forced split bypasses gain/min_data checks (it is
-            # forced) but both children must receive rows and the
-            # target must respect max_depth; otherwise the entry and
-            # its subtree are skipped
-            applied = (in_forced & (f_tgt >= 0)
-                       & (f_lsums[2] > 0) & (f_rsums[2] > 0))
-            if cfg.max_depth > 0:
-                applied = applied \
-                    & (s.leaf_depth[tgt_c] < cfg.max_depth)
+            # ---- forced rounds: every READY entry this round ---------
+            tgt = s.forced_target                          # [M]
+            ready = tgt >= 0
+            in_forced = jnp.any(ready | (tgt == -1))
+            tgt_cl = jnp.clip(tgt, 0, L)
+            is_forced_leaf = jnp.zeros(L + 1, jnp.bool_).at[
+                jnp.where(ready, tgt_cl, L)].set(True).at[L].set(False)
+            # while entries remain, ONLY forced targets may split
+            # (reference applies all forced splits before free growth)
             gains = jnp.where(
                 in_forced,
-                jnp.where(applied
-                          & (jnp.arange(L + 1, dtype=i32) == tgt_c),
-                          jnp.float32(3e38), NEG_INF),
+                jnp.where(is_forced_leaf, jnp.float32(3e38), NEG_INF),
                 gains)
         top_gain, top_leaf = jax.lax.top_k(gains, Kb)
         remaining = (L - 1) - s.split_idx
         valid = jnp.isfinite(top_gain) \
             & (jnp.arange(Kb, dtype=i32) < remaining)
+        if forced is not None:
+            from ..ops.split import leaf_gain as _lg
+            # match each batch lane to its forced entry (targets are
+            # unique per leaf slot, so at most one entry per lane)
+            lane_match = ((top_leaf[:, None] == tgt[None, :])
+                          & ready[None, :])                  # [Kb, M]
+            flane = jnp.any(lane_match, axis=1) & in_forced  # [Kb]
+
+            def esel(arr):
+                return jnp.sum(
+                    jnp.where(lane_match, arr[None, :].astype(i32), 0),
+                    axis=1)
+
+            ff_k = esel(f_feat)                              # [Kb]
+            ftb_k = esel(f_tbin)
+            fcat_k = jnp.any(lane_match & f_is_cat[None, :], axis=1)
+            fbs_k = jnp.sum(
+                jnp.where(lane_match[:, :, None],
+                          f_bitset[None, :, :],
+                          jnp.uint32(0)), axis=1)            # [Kb, W]
+            # per-lane child sums from the pool histogram: gather the
+            # target leaves' histograms with the one-hot matmul trick
+            oh_tf = (top_leaf[:, None]
+                     == jnp.arange(L + 1, dtype=i32)[None, :]
+                     ).astype(jnp.float32)
+            fhist = jax.lax.dot_general(
+                oh_tf, s.leaf_hist.reshape(L + 1, -1),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST).reshape(
+                    Kb, s.leaf_hist.shape[1], B, 3)
+            oh_ff = (ff_k[:, None]
+                     == jnp.arange(F_meta, dtype=i32)[None, :])
+            col_f = jnp.sum(
+                jnp.where(oh_ff[:, :, None, None], fhist, 0.0),
+                axis=1)                                       # [Kb,B,3]
+            bidx_f = jnp.arange(B, dtype=i32)[None, :]
+            nanb_f = (feat_has_nan[ff_k][:, None]
+                      & (bidx_f == feat_num_bin[ff_k][:, None] - 1))
+            num_lm = (bidx_f <= ftb_k[:, None]) & ~nanb_f
+            word_k = jnp.take_along_axis(
+                fbs_k, (bidx_f >> 5).astype(i32), axis=1)
+            cat_lm = ((word_k >> (bidx_f & 31).astype(jnp.uint32))
+                      & jnp.uint32(1)) > 0
+            lm_f = jnp.where(fcat_k[:, None], cat_lm, num_lm) \
+                & (bidx_f < feat_num_bin[ff_k][:, None])
+            f_lsums = jnp.sum(col_f * lm_f[:, :, None], axis=1)
+            f_psums2 = s.leaf_sums[jnp.clip(top_leaf, 0, L)]
+            f_rsums = f_psums2 - f_lsums
+            # forced splits bypass gain/min_data checks, but both
+            # children must receive rows (and respect max_depth);
+            # otherwise the entry and its subtree are skipped
+            applied_k = (flane & (f_lsums[:, 2] > 0)
+                         & (f_rsums[:, 2] > 0))
+            if cfg.max_depth > 0:
+                applied_k = applied_k \
+                    & (s.leaf_depth[jnp.clip(top_leaf, 0, L)]
+                       < cfg.max_depth)
+            valid = valid & (~flane | applied_k)
         nv = jnp.sum(valid).astype(i32)
         rank = jnp.cumsum(valid.astype(i32)) - 1
         node_ids = jnp.where(valid, s.split_idx + rank, node_trash)
@@ -725,24 +821,23 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         bs_sel = (s.best_cat_bitset[tl_safe] if cfg.has_categorical
                   else None)
         if forced is not None:
-            from ..ops.split import leaf_gain as _lg
-            flane = (jnp.arange(Kb, dtype=i32) == 0) & applied
-            feat_sel = jnp.where(flane, ff_i, feat_sel)
-            thr_sel = jnp.where(flane, ftb_i, thr_sel)
+            # substitute the forced entries' attributes on their lanes
+            # (analysis arrays computed above, before `valid`)
+            feat_sel = jnp.where(flane, ff_k, feat_sel)
+            thr_sel = jnp.where(flane, ftb_k, thr_sel)
             dl_sel = jnp.where(flane, False, dl_sel)
             lsums_sel = jnp.where(flane[:, None], f_lsums, lsums_sel)
             rsums_sel = jnp.where(flane[:, None], f_rsums, rsums_sel)
-            g_forced = (_lg(f_lsums[0], f_lsums[1], cfg.lambda_l1,
+            g_forced = (_lg(f_lsums[:, 0], f_lsums[:, 1], cfg.lambda_l1,
                             cfg.lambda_l2)
-                        + _lg(f_rsums[0], f_rsums[1], cfg.lambda_l1,
-                              cfg.lambda_l2)
-                        - _lg(f_psums[0], f_psums[1], cfg.lambda_l1,
-                              cfg.lambda_l2))
+                        + _lg(f_rsums[:, 0], f_rsums[:, 1],
+                              cfg.lambda_l1, cfg.lambda_l2)
+                        - _lg(f_psums2[:, 0], f_psums2[:, 1],
+                              cfg.lambda_l1, cfg.lambda_l2))
             gain_rec = jnp.where(flane, g_forced, gain_rec)
             if cfg.has_categorical:
-                cat_sel = jnp.where(flane, False, cat_sel)
-                bs_sel = jnp.where(flane[:, None], jnp.uint32(0),
-                                   bs_sel)
+                cat_sel = jnp.where(flane, fcat_k, cat_sel)
+                bs_sel = jnp.where(flane[:, None], fbs_k, bs_sel)
         attr_cols = [feat_sel.astype(jnp.float32),
                      thr_sel.astype(jnp.float32),
                      dl_sel.astype(jnp.float32),
@@ -937,18 +1032,44 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 act = leaf_ax < s.num_leaves                     # [L+1]
                 vals_c = s.leaf_value
                 big = jnp.float32(jnp.inf)
-                inf_r = jnp.where(s.mono_right & act[None, :],
+                if use_mono_adv:
+                    # ADVANCED (AdvancedLeafConstraints): each node
+                    # binds only the leaves of either subtree that are
+                    # ADJACENT to its boundary in its split feature
+                    # (leaf bin range touching the threshold); shielded
+                    # leaves are ordered transitively through the
+                    # adjacent strip chain, so their bounds — and the
+                    # strip aggregates below — are strictly looser than
+                    # intermediate's whole-subtree min/max.
+                    oh_nf = (s.split_feature[:, None]
+                             == jnp.arange(F_meta, dtype=i32)[None, :]
+                             ).astype(jnp.float32)       # [L, F_meta]
+                    lo_f = jax.lax.dot_general(
+                        oh_nf, s.leaf_flo.astype(jnp.float32),
+                        dimension_numbers=(((1,), (1,)), ((), ())),
+                        precision=jax.lax.Precision.HIGHEST)  # [L, L+1]
+                    hi_f = jax.lax.dot_general(
+                        oh_nf, s.leaf_fhi.astype(jnp.float32),
+                        dimension_numbers=(((1,), (1,)), ((), ())),
+                        precision=jax.lax.Precision.HIGHEST)
+                    tjf = s.threshold_bin.astype(jnp.float32)[:, None]
+                    ncat = s.node_is_cat[:, None]        # [L, 1]
+                    ml_eff = s.mono_left & (ncat | (hi_f == tjf))
+                    mr_eff = s.mono_right & (ncat | (lo_f == tjf + 1.0))
+                else:
+                    ml_eff, mr_eff = s.mono_left, s.mono_right
+                inf_r = jnp.where(mr_eff & act[None, :],
                                   vals_c[None, :], big)
-                inf_l = jnp.where(s.mono_left & act[None, :],
+                inf_l = jnp.where(ml_eff & act[None, :],
                                   vals_c[None, :], big)
                 rmin = jnp.min(inf_r, axis=1)                    # [L]
                 lmin = jnp.min(inf_l, axis=1)
-                rmax = jnp.max(jnp.where(s.mono_right & act[None, :],
+                rmax = jnp.max(jnp.where(mr_eff & act[None, :],
                                          vals_c[None, :], -big), axis=1)
-                lmax = jnp.max(jnp.where(s.mono_left & act[None, :],
+                lmax = jnp.max(jnp.where(ml_eff & act[None, :],
                                          vals_c[None, :], -big), axis=1)
-                in_l = s.mono_left[:, tl_safe]                   # [L, Kb]
-                in_r = s.mono_right[:, tl_safe]
+                in_l = ml_eff[:, tl_safe]                        # [L, Kb]
+                in_r = mr_eff[:, tl_safe]
                 # batch race guard: when THIS round splits leaves on
                 # BOTH sides of a constrained node, each side would use
                 # the other's pre-round value and their children could
@@ -1024,10 +1145,32 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             mr = mr.at[node_ids, new_ids].set(True)
         else:
             ml, mr = s.mono_left, s.mono_right
+        if use_mono_adv:
+            # per-leaf feature bin ranges: children inherit the split
+            # leaf's ranges; a NUMERICAL split narrows the split
+            # feature's range at the threshold (categorical splits
+            # leave ranges whole — their nodes bind whole subtrees)
+            flo_p = s.leaf_flo[tl_safe]                  # [Kb, F_meta]
+            fhi_p = s.leaf_fhi[tl_safe]
+            oh_sf = (feat_sel[:, None]
+                     == jnp.arange(F_meta, dtype=i32)[None, :])
+            upd = oh_sf & valid[:, None]
+            if cfg.has_categorical:
+                upd = upd & ~cat_sel[:, None]
+            fhi_left = jnp.where(upd, thr_sel[:, None], fhi_p)
+            flo_right = jnp.where(upd, thr_sel[:, None] + 1, flo_p)
+            ids2_r = jnp.concatenate([tl_safe, new_ids])
+            leaf_flo2 = s.leaf_flo.at[ids2_r].set(
+                jnp.concatenate([flo_p, flo_right]))
+            leaf_fhi2 = s.leaf_fhi.at[ids2_r].set(
+                jnp.concatenate([fhi_left, fhi_p]))
+        else:
+            leaf_flo2, leaf_fhi2 = s.leaf_flo, s.leaf_fhi
 
         # ---- best splits for all 2*Kb children -------------------------
         child_hists = jnp.concatenate([left_hist, right_hist])
         child_sums = jnp.concatenate([lsums, rsums])
+        ids2 = jnp.concatenate([tl_safe, new_ids])
         bests = search_best(child_hists, child_sums,
                             child_lower, child_upper, child_allow,
                             parent_outs=(jnp.concatenate([lvals, rvals])
@@ -1036,8 +1179,9 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                             round_tag=s.split_idx,
                             depths=(jnp.concatenate([depth2, depth2])
                                     if cfg.monotone_penalty > 0.0
-                                    else None))
-        ids2 = jnp.concatenate([tl_safe, new_ids])
+                                    else None),
+                            pen2=(lazy_pen2(ids2, leaf_id)
+                                  if lazy is not None else None))
 
         # ---- tree wiring -----------------------------------------------
         lc = s.left_child.at[node_ids].set(-top_leaf - 1)
@@ -1051,6 +1195,33 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                                         node_ids))
         rc = rc.at[fix_r].set(jnp.where(fix_r == node_trash, rc[fix_r],
                                         node_ids))
+
+        # ---- forced-entry state resolution -----------------------------
+        if forced is not None:
+            sel_applied = lane_match & applied_k[:, None]    # [Kb, M]
+            applied_entry = jnp.any(sel_applied, axis=0)     # [M]
+            attempted = jnp.any(lane_match & flane[:, None], axis=0)
+            skipped = attempted & ~applied_entry
+            fp_c = jnp.clip(f_parent, 0, M_f - 1)
+            # children resolve against the lane where their parent
+            # applied: left child keeps the parent's leaf slot, right
+            # child takes the new leaf id minted in that lane
+            pm = sel_applied[:, fp_c]                        # [Kb, M]
+            child_tgt = jnp.where(
+                f_is_left,
+                jnp.sum(jnp.where(pm, tl_safe[:, None], 0), axis=0),
+                jnp.sum(jnp.where(pm, new_ids[:, None], 0), axis=0))
+            resolved_now = jnp.any(pm, axis=0) & (f_parent >= 0)
+            parent_dead = (f_parent >= 0) & (
+                skipped[fp_c] | (tgt[fp_c] == -2))
+            forced_tgt_next = jnp.where(
+                applied_entry, -3,
+                jnp.where(skipped, -2,
+                          jnp.where(tgt == -1,
+                                    jnp.where(resolved_now, child_tgt,
+                                              jnp.where(parent_dead,
+                                                        -2, -1)),
+                                    tgt))).astype(i32)
 
         new = GrowState(
             split_idx=s.split_idx + nv,
@@ -1107,25 +1278,26 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                        if cfg.has_interaction else s.leaf_used),
             mono_left=ml,
             mono_right=mr,
+            leaf_flo=leaf_flo2,
+            leaf_fhi=leaf_fhi2,
             leaf_id_c=leaf_id_c,
-            forced_ptr=(s.forced_ptr
-                        + jnp.where(in_forced, 1, 0).astype(i32)
-                        if forced is not None else s.forced_ptr),
-            forced_target=(jnp.where(
-                in_forced & (f_parent == fp),
-                jnp.where(applied,
-                          jnp.where(f_is_left, tgt_c, s.num_leaves),
-                          -2),
-                s.forced_target).astype(i32)
-                if forced is not None else s.forced_target),
+            forced_target=(forced_tgt_next if forced is not None
+                           else s.forced_target),
         )
         next_gains = _masked_gains(new.best_gain, new.leaf_depth,
                                    new.num_leaves, cfg.max_depth)
         keep_going = jnp.isfinite(jnp.max(next_gains)) & (nv > 0)
         if forced is not None:
-            # skipped/cancelled forced rounds split nothing (nv == 0)
-            # but must not terminate growth while entries remain
-            keep_going = keep_going | (new.forced_ptr < M_f)
+            # forced rounds may split nothing (entries skipped at
+            # runtime: empty child, depth cap). Growth must neither
+            # terminate while entries remain NOR when the LAST entries
+            # cancel in a zero-split round — free growth resumes next
+            # round as long as any leaf still has finite gain.
+            keep_going = (keep_going
+                          | jnp.any((forced_tgt_next == -1)
+                                    | (forced_tgt_next >= 0))
+                          | (in_forced
+                             & jnp.isfinite(jnp.max(next_gains))))
         return new._replace(has_split=keep_going)
 
     final = jax.lax.while_loop(cond, body, state)

@@ -135,6 +135,55 @@ def test_monotone_intermediate_enforced_and_tighter_fit():
     assert mse_i <= mse_b * 1.02, (mse_i, mse_b)
 
 
+def test_monotone_advanced_enforced_and_at_least_intermediate():
+    """AdvancedLeafConstraints analog: boundary-adjacent strip bounds
+    are looser than intermediate's whole-subtree min/max, so the
+    constrained fit must not get worse — while every response curve
+    stays monotone."""
+    X, y = _data(n=6000, seed=11)
+    grid = np.linspace(-2, 2, 201)
+    params = {"objective": "regression", "num_leaves": 31,
+              "verbosity": -1, "monotone_constraints": [1, 0, 0, 0]}
+    inter = lgb.train({**params,
+                       "monotone_constraints_method": "intermediate"},
+                      lgb.Dataset(X, label=y), num_boost_round=60)
+    adv = lgb.train({**params,
+                     "monotone_constraints_method": "advanced"},
+                    lgb.Dataset(X, label=y), num_boost_round=60)
+    rng = np.random.default_rng(12)
+    for _ in range(8):
+        row = rng.uniform(-2, 2, size=4)
+        r = _response_curve(adv, row, 0, grid)
+        assert np.min(np.diff(r)) >= -1e-6, "advanced violates"
+    mse_i = float(np.mean((inter.predict(X) - y) ** 2))
+    mse_a = float(np.mean((adv.predict(X) - y) ** 2))
+    # looser (but sound) bounds can only help the fit (tie tolerance)
+    assert mse_a <= mse_i * 1.02, (mse_a, mse_i)
+
+
+def test_monotone_advanced_both_directions_multifeature():
+    """Advanced with two constrained features of opposite directions
+    keeps both response monotonicities."""
+    rng = np.random.default_rng(13)
+    X = rng.uniform(-2, 2, size=(5000, 4))
+    y = (0.9 * X[:, 0] - 0.7 * X[:, 1]
+         - 1.5 * np.exp(-((X[:, 0] - 0.3) ** 2) / 0.05)
+         + 1.2 * np.exp(-((X[:, 1] + 0.4) ** 2) / 0.05)
+         + 0.4 * X[:, 2] + rng.normal(scale=0.1, size=5000))
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "monotone_constraints": [1, -1, 0, 0],
+         "monotone_constraints_method": "advanced"},
+        lgb.Dataset(X, label=y), num_boost_round=40)
+    grid = np.linspace(-2, 2, 151)
+    for _ in range(5):
+        row = rng.uniform(-2, 2, size=4)
+        assert np.min(np.diff(_response_curve(bst, row, 0, grid))) \
+            >= -1e-6
+        assert np.max(np.diff(_response_curve(bst, row, 1, grid))) \
+            <= 1e-6
+
+
 def test_monotone_penalty_pushes_constrained_splits_down():
     """ComputeMonotoneSplitGainPenalty: a large penalty makes the
     constrained feature unusable near the root."""

@@ -66,7 +66,9 @@ def test_compact_with_multiclass_and_quantized():
 def test_compact_engine_flag_and_fallbacks():
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
-    X, y = _data(2000, 6)
+    # large enough that the compacted buffer (sampled rows + write
+    # slack) genuinely shrinks the scan
+    X, y = _data(20000, 6)
     ds = lgb.Dataset(X, label=y)
     eng = GBDT(Config({"objective": "binary",
                        "data_sample_strategy": "goss",
@@ -78,6 +80,14 @@ def test_compact_engine_flag_and_fallbacks():
                         "data_sample_strategy": "goss",
                         "tpu_goss_compact": True, "verbosity": -1}), ds2)
     assert not eng2._use_goss_compact
+    # tiny datasets: the buffer bound exceeds the data -> masked path
+    # (round-4 guard; the kernel's write windows can then never clamp)
+    Xs, ys = _data(2000, 6)
+    eng3 = GBDT(Config({"objective": "binary",
+                        "data_sample_strategy": "goss",
+                        "tpu_goss_compact": True, "verbosity": -1}),
+                lgb.Dataset(Xs, label=ys))
+    assert not eng3._use_goss_compact
 
 
 def test_goss_selects_exact_counts():

@@ -367,6 +367,92 @@ def test_forcedsplits_structure_respected(tmp_path):
     assert used[int(np.asarray(t0.split_feature)[0])] == 0
 
 
+def test_forcedsplits_siblings_apply_together(tmp_path):
+    """Round 4: independent forced entries (siblings) land in the SAME
+    leaf-batch round — a root + both children table fills nodes 0..2
+    of every tree with the forced structure (the old one-entry-per-
+    round path consumed k rounds; now ~depth(table))."""
+    import json
+    rng = np.random.default_rng(21)
+    X = rng.uniform(-1, 1, size=(4000, 5))
+    y = 3.0 * X[:, 0] + rng.normal(scale=0.1, size=4000)
+    fs = str(tmp_path / "forced.json")
+    with open(fs, "w") as f:
+        json.dump({"feature": 1, "threshold": 0.0,
+                   "left": {"feature": 2, "threshold": 0.1},
+                   "right": {"feature": 3, "threshold": -0.1}}, f)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "forcedsplits_filename": fs, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    used = bst.engine.train_set.used_features
+    assert bst.engine._n_forced == 3
+    for t in bst.engine.models:
+        sf = [used[int(f)] for f in np.asarray(t.split_feature[:3])]
+        assert sf[0] == 1, sf
+        # both sibling entries applied in the round after the root
+        assert set(sf[1:3]) == {2, 3}, sf
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.8
+
+
+def test_forcedsplits_categorical(tmp_path):
+    """Round 4: forced CATEGORICAL entries — "threshold" lists the
+    category values that go left; the node must appear as a
+    categorical split at the top of every tree."""
+    import json
+    rng = np.random.default_rng(22)
+    n = 4000
+    X = rng.uniform(-1, 1, size=(n, 4))
+    c = rng.integers(0, 8, size=n)
+    X[:, 3] = c
+    y = (2.0 * X[:, 0] + np.where(np.isin(c, [2, 5]), 1.5, 0.0)
+         + rng.normal(scale=0.1, size=n))
+    fs = str(tmp_path / "forced.json")
+    with open(fs, "w") as f:
+        json.dump({"feature": 3, "threshold": [2, 5]}, f)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "forcedsplits_filename": fs, "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[3]),
+                    num_boost_round=3)
+    assert bst.engine._n_forced == 1
+    used = bst.engine.train_set.used_features
+    for t in bst.engine.models:
+        assert used[int(t.split_feature[0])] == 3
+        assert t.is_categorical is not None and t.is_categorical[0]
+    # categories 2 and 5 route together at the root
+    pred = bst.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
+    # an unseen-category-only forced split is skipped gracefully
+    fs2 = str(tmp_path / "forced2.json")
+    with open(fs2, "w") as f:
+        json.dump({"feature": 3, "threshold": [99]}, f)
+    b2 = lgb.train({"objective": "regression", "num_leaves": 7,
+                    "forcedsplits_filename": fs2, "verbosity": -1},
+                   lgb.Dataset(X, label=y, categorical_feature=[3]),
+                   num_boost_round=2)
+    assert b2.engine._n_forced == 0
+
+
+def test_forcedsplits_inapplicable_entry_resumes_free_growth(tmp_path):
+    """A forced entry skipped at RUNTIME (threshold above the feature's
+    range -> an empty child) must not halt growth: free search resumes
+    and the trees still learn (round-4 termination fix)."""
+    import json
+    rng = np.random.default_rng(23)
+    X = rng.uniform(-1, 1, size=(3000, 3))
+    y = 2.0 * X[:, 0] + rng.normal(scale=0.1, size=3000)
+    fs = str(tmp_path / "forced.json")
+    with open(fs, "w") as f:
+        json.dump({"feature": 1, "threshold": 100.0,    # beyond max
+                   "left": {"feature": 2, "threshold": 0.0}}, f)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "forcedsplits_filename": fs, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    # the skipped entry cancelled its subtree, but trees grew freely
+    assert all(int(np.asarray(t.num_leaves)) > 2
+               for t in bst.engine.models)
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.9
+
+
 def test_forcedsplits_unused_feature_skipped(tmp_path):
     """A forced split on a constant (dropped) feature is skipped with
     its subtree; training proceeds normally."""
@@ -399,3 +485,61 @@ def test_unimplemented_param_warns():
         log.register_callback(None)
         log.set_verbosity(-1)
     assert any("parser_config_file" in m for m in msgs), msgs
+
+
+def test_cegb_lazy_differs_from_coupled():
+    """cegb_penalty_feature_lazy (round 4): per-row acquisition — the
+    penalty scales with the UNACQUIRED row count of the candidate
+    leaf, so (a) a large lazy penalty suppresses a feature that the
+    same-value COUPLED penalty (charged once per model) still buys,
+    and (b) zero penalties reproduce the unpenalized model."""
+    rng = np.random.default_rng(31)
+    X = rng.normal(size=(3000, 5))
+    y = 2.0 * X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.2, size=3000)
+    base = {"objective": "regression", "num_leaves": 15,
+            "verbosity": -1}
+
+    def f0_splits(b):
+        used = b.engine.train_set.used_features
+        u0 = used.index(0)
+        return sum(int(np.sum(np.asarray(
+            t.split_feature[:t.num_nodes]) == u0))
+            for t in b.engine.models)
+
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=6)
+    bl = lgb.train({**base,
+                    "cegb_penalty_feature_lazy": [50.0, 0, 0, 0, 0]},
+                   lgb.Dataset(X, label=y), num_boost_round=6)
+    bc = lgb.train({**base,
+                    "cegb_penalty_feature_coupled": [50.0, 0, 0, 0, 0]},
+                   lgb.Dataset(X, label=y), num_boost_round=6)
+    assert f0_splits(b0) > 0
+    assert f0_splits(bl) == 0            # per-row cost prices f0 out
+    assert f0_splits(bc) > 0             # one-off cost does not
+    # zero lazy penalties == baseline, bit for bit
+    bz = lgb.train({**base,
+                    "cegb_penalty_feature_lazy": [0, 0, 0, 0, 0]},
+                   lgb.Dataset(X, label=y), num_boost_round=6)
+    np.testing.assert_allclose(bz.predict(X[:200]), b0.predict(X[:200]),
+                               rtol=1e-7)
+
+
+def test_cegb_lazy_acquisition_discounts_later_trees():
+    """Once rows acquire a feature (their path used it), later splits
+    on it cost nothing for those rows: with a moderate lazy penalty
+    the feature still enters the model (unlike the prohibitive case),
+    and the fit stays sane."""
+    rng = np.random.default_rng(32)
+    X = rng.normal(size=(4000, 4))
+    y = 3.0 * X[:, 0] + rng.normal(scale=0.2, size=4000)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1,
+                     "cegb_penalty_feature_lazy": [0.5, 0, 0, 0]},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    used = bst.engine.train_set.used_features
+    u0 = used.index(0)
+    per_tree = [int(np.sum(np.asarray(
+        t.split_feature[:t.num_nodes]) == u0))
+        for t in bst.engine.models]
+    assert sum(per_tree) > 0             # moderate cost is payable
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.9
