@@ -1,0 +1,587 @@
+// Minimal native C ABI for the model/predict surface (the L7 seam).
+//
+// Reference analog: src/c_api.cpp's ~90 LGBM_* functions (UNVERIFIED —
+// empty mount, see SURVEY.md banner). A TPU/JAX training framework has no
+// use for a C training ABI (training is a jitted XLA program driven from
+// Python), but the PREDICT/model surface is exactly where a stable ABI
+// earns its keep: deployment inference from C/C++/Go/Rust services with
+// zero Python/JAX runtime. This file is that surface: a standalone
+// C++17 parser for the LightGBM v4 model text format plus an
+// OpenMP-parallel predictor, exported as ~10 extern "C" functions
+// mirroring the reference's naming (BoosterCreateFromModelfile,
+// BoosterPredictForMat, GetLastError, ...).
+//
+// Semantics mirror lightgbm_tpu.tree.Tree._leaf_index_raw /
+// io/model_text.py HostModel.predict bit-for-bit:
+//   - decision_type bit0 = categorical, bit1 = default_left,
+//     bits2-3 = missing type (0 none / 1 zero / 2 nan)
+//   - missing "none": NaN behaves as 0.0; "zero": |x|<=1e-35 and NaN
+//     take the default direction; "nan": NaN takes the default
+//   - categorical: value-level uint32 bitset membership; NaN, negative
+//     and out-of-range values miss the set and go right
+//   - linear leaves: leaf_const + sum(coef*x) with constant-leaf
+//     fallback when any referenced feature is non-finite
+//   - average_output divides raw by the iteration count (RF)
+//   - objective transforms: binary sigmoid, softmax, ova-normalize,
+//     exp (poisson/gamma/tweedie), xentropy sigmoid, regression sqrt
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+struct NativeTree {
+  int num_leaves = 1;
+  std::vector<int32_t> split_feature, left_child, right_child;
+  std::vector<double> threshold;
+  std::vector<uint8_t> decision_type;
+  std::vector<double> leaf_value;
+  // categorical payload (LightGBM layout: threshold[i] indexes
+  // cat_boundaries; that range delimits uint32 words in cat_threshold)
+  std::vector<int64_t> cat_boundaries;
+  std::vector<uint32_t> cat_threshold;
+  // linear-leaf payload
+  bool is_linear = false;
+  std::vector<double> leaf_const;
+  std::vector<std::vector<int32_t>> leaf_features;
+  std::vector<std::vector<double>> leaf_coeff;
+
+  int LeafIndex(const double* row) const {
+    if (num_leaves <= 1) return 0;
+    int nd = 0;
+    for (;;) {
+      const double v = row[split_feature[nd]];
+      const uint8_t dt = decision_type[nd];
+      bool go_left;
+      if (dt & 1) {  // categorical bitset membership
+        go_left = false;
+        if (std::isfinite(v) && v >= 0) {
+          const int64_t iv = static_cast<int64_t>(v);
+          const int ci = static_cast<int>(threshold[nd]);
+          const int64_t start = cat_boundaries[ci];
+          const int64_t nw = cat_boundaries[ci + 1] - start;
+          const int64_t w = iv >> 5;
+          if (w < nw) {
+            go_left = (cat_threshold[start + w] >> (iv & 31)) & 1u;
+          }
+        }
+      } else {
+        const bool dl = dt & 2;
+        const int mt = (dt >> 2) & 3;
+        const bool miss = std::isnan(v);
+        if (mt == 2) {            // nan
+          go_left = miss ? dl : (v <= threshold[nd]);
+        } else if (mt == 1) {     // zero
+          const double v0 = miss ? 0.0 : v;
+          go_left = (miss || std::fabs(v0) <= 1e-35)
+                        ? dl : (v0 <= threshold[nd]);
+        } else {                  // none: NaN behaves as 0.0
+          go_left = (miss ? 0.0 : v) <= threshold[nd];
+        }
+      }
+      const int nxt = go_left ? left_child[nd] : right_child[nd];
+      if (nxt < 0) return -nxt - 1;
+      nd = nxt;
+    }
+  }
+
+  double LeafOutput(int leaf, const double* row) const {
+    if (!is_linear) return leaf_value[leaf];
+    // text-format linear leaves always carry leaf_const; rows whose
+    // referenced features contain a non-finite value fall back to the
+    // constant leaf_value (tree.h Tree::Predict nan_found semantics)
+    double s = leaf_const[leaf];
+    const auto& feats = leaf_features[leaf];
+    const auto& coefs = leaf_coeff[leaf];
+    for (size_t i = 0; i < feats.size(); ++i) {
+      const double v = row[feats[i]];
+      if (!std::isfinite(v)) return leaf_value[leaf];
+      s += coefs[i] * v;
+    }
+    return s;
+  }
+};
+
+struct NativeBooster {
+  std::vector<NativeTree> trees;
+  int num_class = 1;
+  int num_tree_per_iteration = 1;
+  int max_feature_idx = 0;
+  bool average_output = false;
+  std::string objective = "regression";
+  std::string model_str;  // retained verbatim for SaveModel
+
+  int NumIterations() const {
+    const int k = num_tree_per_iteration > 0 ? num_tree_per_iteration : 1;
+    return static_cast<int>(trees.size()) / k;
+  }
+};
+
+// ---------------------------------------------------------------------
+// model text parsing
+// ---------------------------------------------------------------------
+bool ParseIntArray(const std::string& s, std::vector<int32_t>* out) {
+  out->clear();
+  const char* p = s.c_str();
+  char* end;
+  for (;;) {
+    while (*p == ' ') ++p;
+    if (!*p) break;
+    // thresholds for cat splits are written as floats by some writers;
+    // accept any numeric token
+    const double v = std::strtod(p, &end);
+    if (end == p) return false;
+    out->push_back(static_cast<int32_t>(v));
+    p = end;
+  }
+  return true;
+}
+
+bool ParseDoubleArray(const std::string& s, std::vector<double>* out) {
+  out->clear();
+  const char* p = s.c_str();
+  char* end;
+  for (;;) {
+    while (*p == ' ') ++p;
+    if (!*p) break;
+    const double v = std::strtod(p, &end);
+    if (end == p) return false;
+    out->push_back(v);
+    p = end;
+  }
+  return true;
+}
+
+// key=value lines of one tree block into a small map (vector of pairs;
+// blocks have ~20 keys so linear scan is fine)
+struct KVBlock {
+  std::vector<std::pair<std::string, std::string>> kv;
+  const std::string* Get(const char* key) const {
+    for (const auto& p : kv) {
+      if (p.first == key) return &p.second;
+    }
+    return nullptr;
+  }
+};
+
+KVBlock SplitKVLines(const std::string& text) {
+  KVBlock out;
+  std::istringstream ss(text);
+  std::string line;
+  while (std::getline(ss, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    const size_t eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    out.kv.emplace_back(line.substr(0, eq), line.substr(eq + 1));
+  }
+  return out;
+}
+
+bool ParseTree(const std::string& block, NativeTree* t,
+               int max_feature_idx) {
+  const KVBlock kv = SplitKVLines(block);
+  const std::string* s = kv.Get("num_leaves");
+  if (!s) return false;
+  t->num_leaves = std::atoi(s->c_str());
+  const int nn = t->num_leaves > 1 ? t->num_leaves - 1 : 0;
+
+  // strict parsing: a present array must tokenize cleanly and carry
+  // exactly n entries — zero-filling a corrupted field would load a
+  // booster that silently predicts garbage
+  bool parse_ok = true;
+  auto geti = [&](const char* k, int n, std::vector<int32_t>* out) {
+    const std::string* v = kv.Get(k);
+    if (!v || v->find_first_not_of(' ') == std::string::npos) {
+      out->assign(n, 0);
+      return;
+    }
+    if (!ParseIntArray(*v, out) ||
+        out->size() != static_cast<size_t>(n)) {
+      parse_ok = false;
+      out->resize(n, 0);
+    }
+  };
+  auto getf = [&](const char* k, int n, std::vector<double>* out) {
+    const std::string* v = kv.Get(k);
+    if (!v || v->find_first_not_of(' ') == std::string::npos) {
+      out->assign(n, 0.0);
+      return;
+    }
+    if (!ParseDoubleArray(*v, out) ||
+        out->size() != static_cast<size_t>(n)) {
+      parse_ok = false;
+      out->resize(n, 0.0);
+    }
+  };
+
+  geti("split_feature", nn, &t->split_feature);
+  geti("left_child", nn, &t->left_child);
+  geti("right_child", nn, &t->right_child);
+  getf("threshold", nn, &t->threshold);
+  getf("leaf_value", t->num_leaves, &t->leaf_value);
+  std::vector<int32_t> dt;
+  geti("decision_type", nn, &dt);
+  t->decision_type.assign(dt.begin(), dt.end());
+
+  const std::string* nc = kv.Get("num_cat");
+  if (nc && std::atoi(nc->c_str()) > 0) {
+    std::vector<int32_t> cb;
+    geti("cat_boundaries", std::atoi(nc->c_str()) + 1, &cb);
+    t->cat_boundaries.assign(cb.begin(), cb.end());
+    const std::string* ct = kv.Get("cat_threshold");
+    std::vector<double> ctd;
+    if (ct && !ParseDoubleArray(*ct, &ctd)) parse_ok = false;
+    t->cat_threshold.clear();
+    for (double v : ctd) {
+      t->cat_threshold.push_back(static_cast<uint32_t>(v));
+    }
+  }
+
+  const std::string* lin = kv.Get("is_linear");
+  if (lin && std::atoi(lin->c_str()) == 1 && kv.Get("leaf_const")) {
+    t->is_linear = true;
+    getf("leaf_const", t->num_leaves, &t->leaf_const);
+    std::vector<int32_t> counts, feats_flat;
+    std::vector<double> coefs_flat;
+    geti("num_features", t->num_leaves, &counts);
+    const std::string* ff = kv.Get("leaf_features");
+    if (ff && !ParseIntArray(*ff, &feats_flat)) parse_ok = false;
+    const std::string* cf = kv.Get("leaf_coeff");
+    if (cf && !ParseDoubleArray(*cf, &coefs_flat)) parse_ok = false;
+    t->leaf_features.resize(t->num_leaves);
+    t->leaf_coeff.resize(t->num_leaves);
+    size_t off = 0;
+    for (int lf = 0; lf < t->num_leaves; ++lf) {
+      const size_t c = counts[lf] > 0 ? counts[lf] : 0;
+      if (off + c <= feats_flat.size() && off + c <= coefs_flat.size()) {
+        t->leaf_features[lf].assign(feats_flat.begin() + off,
+                                    feats_flat.begin() + off + c);
+        t->leaf_coeff[lf].assign(coefs_flat.begin() + off,
+                                 coefs_flat.begin() + off + c);
+      }
+      off += c;
+    }
+  }
+
+  // structural bounds check so a malformed file errors instead of UB:
+  // children in range, split features within the header's feature
+  // count, categorical indices inside cat_boundaries and every
+  // boundary range inside cat_threshold
+  for (size_t i = 0; i + 1 < t->cat_boundaries.size(); ++i) {
+    const int64_t lo = t->cat_boundaries[i];
+    const int64_t hi = t->cat_boundaries[i + 1];
+    if (lo < 0 || hi < lo ||
+        hi > static_cast<int64_t>(t->cat_threshold.size())) {
+      return false;
+    }
+  }
+  if (!parse_ok) return false;
+  for (int i = 0; i < nn; ++i) {
+    const int lc = t->left_child[i], rc = t->right_child[i];
+    // internal children must point FORWARD (creation order) — this is
+    // what makes traversal provably acyclic/terminating
+    if (lc >= nn || rc >= nn || (lc >= 0 && lc <= i) ||
+        (rc >= 0 && rc <= i) || -lc - 1 >= t->num_leaves ||
+        -rc - 1 >= t->num_leaves || t->split_feature[i] < 0 ||
+        t->split_feature[i] > max_feature_idx) {
+      return false;
+    }
+    if (t->decision_type[i] & 1) {
+      const double ci = t->threshold[i];
+      if (!(ci >= 0) || t->cat_boundaries.empty() ||
+          static_cast<size_t>(ci) + 1 >= t->cat_boundaries.size()) {
+        return false;
+      }
+    }
+  }
+  return true;
+}
+
+NativeBooster* ParseModel(const std::string& text) {
+  if (text.compare(0, 4, "tree") != 0) {
+    SetError("Model string doesn't start with the 'tree' magic");
+    return nullptr;
+  }
+  auto booster = new NativeBooster();
+  booster->model_str = text;
+
+  const size_t first_tree = text.find("\nTree=");
+  const std::string head =
+      text.substr(0, first_tree == std::string::npos ? text.size()
+                                                     : first_tree);
+  const KVBlock hkv = SplitKVLines(head);
+  if (const std::string* v = hkv.Get("num_class"))
+    booster->num_class = std::atoi(v->c_str());
+  if (const std::string* v = hkv.Get("num_tree_per_iteration"))
+    booster->num_tree_per_iteration = std::atoi(v->c_str());
+  if (const std::string* v = hkv.Get("max_feature_idx"))
+    booster->max_feature_idx = std::atoi(v->c_str());
+  if (const std::string* v = hkv.Get("objective"))
+    booster->objective = *v;
+  booster->average_output =
+      head.find("\naverage_output") != std::string::npos;
+
+  size_t pos = first_tree;
+  while (pos != std::string::npos) {
+    pos += 1;  // skip '\n'
+    size_t end = text.find("\nTree=", pos);
+    size_t stop = text.find("\nend of trees", pos);
+    size_t block_end = std::min(
+        end == std::string::npos ? text.size() : end,
+        stop == std::string::npos ? text.size() : stop);
+    NativeTree t;
+    if (!ParseTree(text.substr(pos, block_end - pos), &t,
+                   booster->max_feature_idx)) {
+      SetError("Malformed tree block in model string");
+      delete booster;
+      return nullptr;
+    }
+    booster->trees.push_back(std::move(t));
+    pos = (end != std::string::npos && (stop == std::string::npos ||
+                                        end < stop))
+              ? end : std::string::npos;
+  }
+  return booster;
+}
+
+// ---------------------------------------------------------------------
+// prediction
+// ---------------------------------------------------------------------
+enum PredictType { kNormal = 0, kRaw = 1, kLeafIndex = 2 };
+
+// first token of the objective string + a named numeric suffix
+std::string ObjHead(const std::string& obj) {
+  const size_t sp = obj.find(' ');
+  return sp == std::string::npos ? obj : obj.substr(0, sp);
+}
+
+double ObjParam(const std::string& obj, const char* name, double dflt) {
+  const std::string key = std::string(name) + ":";
+  const size_t p = obj.find(key);
+  if (p == std::string::npos) return dflt;
+  return std::atof(obj.c_str() + p + key.size());
+}
+
+void Transform(const NativeBooster& b, double* raw, int k) {
+  const std::string head = ObjHead(b.objective);
+  if (head == "binary") {
+    const double s = ObjParam(b.objective, "sigmoid", 1.0);
+    raw[0] = 1.0 / (1.0 + std::exp(-s * raw[0]));
+  } else if (head == "multiclass" || head == "softmax") {
+    double mx = raw[0];
+    for (int i = 1; i < k; ++i) mx = std::max(mx, raw[i]);
+    double sum = 0.0;
+    for (int i = 0; i < k; ++i) { raw[i] = std::exp(raw[i] - mx);
+                                  sum += raw[i]; }
+    for (int i = 0; i < k; ++i) raw[i] /= sum;
+  } else if (head == "multiclassova") {
+    const double s = ObjParam(b.objective, "sigmoid", 1.0);
+    double sum = 0.0;
+    for (int i = 0; i < k; ++i) {
+      raw[i] = 1.0 / (1.0 + std::exp(-s * raw[i]));
+      sum += raw[i];
+    }
+    for (int i = 0; i < k; ++i) raw[i] /= sum;
+  } else if (head == "poisson" || head == "gamma" || head == "tweedie") {
+    raw[0] = std::exp(raw[0]);
+  } else if (head == "cross_entropy" || head == "xentropy") {
+    raw[0] = 1.0 / (1.0 + std::exp(-raw[0]));
+  } else if (head == "regression" &&
+             b.objective.find(" sqrt") != std::string::npos) {
+    raw[0] = (raw[0] >= 0 ? 1.0 : -1.0) * raw[0] * raw[0];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* LGBMTPU_GetLastError() { return g_last_error.c_str(); }
+
+int LGBMTPU_BoosterLoadModelFromString(const char* model_str,
+                                       int* out_num_iterations,
+                                       void** out_handle) {
+  if (!model_str || !out_handle) {
+    SetError("null argument");
+    return -1;
+  }
+  NativeBooster* b = ParseModel(model_str);
+  if (!b) return -1;
+  if (out_num_iterations) *out_num_iterations = b->NumIterations();
+  *out_handle = b;
+  return 0;
+}
+
+int LGBMTPU_BoosterCreateFromModelfile(const char* filename,
+                                       int* out_num_iterations,
+                                       void** out_handle) {
+  if (!filename || !out_handle) {
+    SetError("null argument");
+    return -1;
+  }
+  std::ifstream f(filename, std::ios::binary);
+  if (!f) {
+    SetError(std::string("Could not open model file: ") + filename);
+    return -1;
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  const std::string text = ss.str();
+  return LGBMTPU_BoosterLoadModelFromString(text.c_str(),
+                                            out_num_iterations,
+                                            out_handle);
+}
+
+int LGBMTPU_BoosterFree(void* handle) {
+  delete static_cast<NativeBooster*>(handle);
+  return 0;
+}
+
+int LGBMTPU_BoosterGetNumClasses(void* handle, int* out) {
+  if (!handle || !out) { SetError("null argument"); return -1; }
+  *out = static_cast<NativeBooster*>(handle)->num_class;
+  return 0;
+}
+
+int LGBMTPU_BoosterGetNumFeature(void* handle, int* out) {
+  if (!handle || !out) { SetError("null argument"); return -1; }
+  *out = static_cast<NativeBooster*>(handle)->max_feature_idx + 1;
+  return 0;
+}
+
+int LGBMTPU_BoosterGetCurrentIteration(void* handle, int* out) {
+  if (!handle || !out) { SetError("null argument"); return -1; }
+  *out = static_cast<NativeBooster*>(handle)->NumIterations();
+  return 0;
+}
+
+int LGBMTPU_BoosterGetNumTreePerIteration(void* handle, int* out) {
+  if (!handle || !out) { SetError("null argument"); return -1; }
+  *out = static_cast<NativeBooster*>(handle)->num_tree_per_iteration;
+  return 0;
+}
+
+int LGBMTPU_BoosterSaveModel(void* handle, const char* filename) {
+  if (!handle || !filename) { SetError("null argument"); return -1; }
+  const NativeBooster* b = static_cast<NativeBooster*>(handle);
+  std::ofstream f(filename, std::ios::binary);
+  if (!f) {
+    SetError(std::string("Could not open for write: ") + filename);
+    return -1;
+  }
+  f << b->model_str;
+  return f.good() ? 0 : -1;
+}
+
+int LGBMTPU_BoosterGetModelSize(void* handle, int64_t* out) {
+  if (!handle || !out) { SetError("null argument"); return -1; }
+  *out = static_cast<int64_t>(
+      static_cast<NativeBooster*>(handle)->model_str.size());
+  return 0;
+}
+
+int LGBMTPU_BoosterGetModelString(void* handle, int64_t buffer_len,
+                                  char* out) {
+  if (!handle || !out) { SetError("null argument"); return -1; }
+  const NativeBooster* b = static_cast<NativeBooster*>(handle);
+  if (buffer_len < static_cast<int64_t>(b->model_str.size()) + 1) {
+    SetError("buffer too small");
+    return -1;
+  }
+  std::memcpy(out, b->model_str.c_str(), b->model_str.size() + 1);
+  return 0;
+}
+
+// data: [nrow, ncol] double, row-major (is_row_major=1) or col-major.
+// predict_type: 0 normal, 1 raw score, 2 leaf index.
+// out_result sizes: normal/raw -> nrow * num_class (binary/regression:
+// nrow); leaf -> nrow * num_used_trees. out_len receives the count.
+int LGBMTPU_BoosterPredictForMat(void* handle, const double* data,
+                                 int32_t nrow, int32_t ncol,
+                                 int is_row_major, int predict_type,
+                                 int start_iteration, int num_iteration,
+                                 double* out_result, int64_t* out_len) {
+  if (!handle || !data || !out_result) {
+    SetError("null argument");
+    return -1;
+  }
+  const NativeBooster& b = *static_cast<NativeBooster*>(handle);
+  if (ncol < b.max_feature_idx + 1) {
+    SetError("Input matrix has " + std::to_string(ncol) +
+             " columns but the model needs " +
+             std::to_string(b.max_feature_idx + 1));
+    return -1;
+  }
+  const int k = b.num_tree_per_iteration > 0 ? b.num_tree_per_iteration
+                                             : 1;
+  const int total_iters = b.NumIterations();
+  if (start_iteration < 0) start_iteration = 0;
+  int iters = num_iteration <= 0 ? total_iters - start_iteration
+                                 : num_iteration;
+  if (iters > total_iters - start_iteration)
+    iters = total_iters - start_iteration;
+  if (iters < 0) iters = 0;
+  const int t0 = start_iteration * k;
+  const int nt = iters * k;
+  const int out_per_row = predict_type == kLeafIndex ? nt : k;
+
+#ifdef _OPENMP
+#pragma omp parallel
+#endif
+  {
+  // col-major inputs are strided-gathered into one per-thread buffer
+  std::vector<double> rowbuf(is_row_major ? 0 : ncol);
+#ifdef _OPENMP
+#pragma omp for schedule(static)
+#endif
+  for (int32_t r = 0; r < nrow; ++r) {
+    const double* row;
+    if (is_row_major) {
+      row = data + static_cast<int64_t>(r) * ncol;
+    } else {
+      for (int32_t c = 0; c < ncol; ++c) {
+        rowbuf[c] = data[static_cast<int64_t>(c) * nrow + r];
+      }
+      row = rowbuf.data();
+    }
+    double* out = out_result + static_cast<int64_t>(r) * out_per_row;
+    if (predict_type == kLeafIndex) {
+      for (int i = 0; i < nt; ++i) {
+        out[i] = b.trees[t0 + i].LeafIndex(row);
+      }
+      continue;
+    }
+    for (int i = 0; i < k; ++i) out[i] = 0.0;
+    for (int i = 0; i < nt; ++i) {
+      const NativeTree& t = b.trees[t0 + i];
+      out[(t0 + i) % k] += t.LeafOutput(t.LeafIndex(row), row);
+    }
+    if (b.average_output && nt > 0) {
+      for (int i = 0; i < k; ++i) out[i] /= (nt / k);
+    }
+    if (predict_type == kNormal) {
+      Transform(b, out, k);
+    }
+  }
+  }  // omp parallel
+  if (out_len) *out_len = static_cast<int64_t>(nrow) * out_per_row;
+  return 0;
+}
+
+}  // extern "C"
