@@ -34,24 +34,24 @@ from ..tree import Tree
 from ..utils import log
 
 
-import functools as _ft
 
 
-@_ft.partial(jax.jit, donate_argnums=(0,))
-def _cegb_u_update_j(U, leaf_ids, pf):
-    """U |= path-features of each row's leaf, per class tree: one-hot
-    [n, L] x [L, F] matmuls (0/1 exact in bf16, f32 accumulation)."""
-    K, L, F = pf.shape
-    for k in range(K):
-        oh = (leaf_ids[k][:, None]
-              == jnp.arange(L, dtype=jnp.int32)[None, :]
-              ).astype(jnp.bfloat16)
-        hit = jax.lax.dot_general(
-            oh, pf[k].astype(jnp.bfloat16),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        U = U | (hit > 0.5)
-    return U
+def _cegb_u_fold(U, leaf_used, leaf_id, in_sample):
+    """U |= path-features of each IN-SAMPLE row's leaf for one tree
+    (cost_effective_gradient_boosting.hpp marks feature-used-in-data on
+    split application, over the bagged/GOSS partition only): one-hot
+    [n, L] x [L, F] matmul (0/1 exact in bf16, f32 accumulation).
+    Runs inside the jitted step so the GOSS sample mask — computed
+    device-side — governs acquisition exactly."""
+    L = leaf_used.shape[0]
+    oh = ((leaf_id[:, None]
+           == jnp.arange(L, dtype=jnp.int32)[None, :])
+          & in_sample[:, None]).astype(jnp.bfloat16)
+    hit = jax.lax.dot_general(
+        oh, leaf_used.astype(jnp.bfloat16),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return U | (hit > 0.5)
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -838,6 +838,13 @@ class GBDT:
                      allowed, qkey=None, cegb_pen=None, cegb_U=None):
             trees, leaf_ids = [], []
             new_score = score
+            U_new = cegb_U
+            if cegb_U is not None:
+                # reference parity: the lazy penalty counts rows of the
+                # SAMPLED partition (bagging/GOSS) — out-of-sample rows
+                # are treated as fully acquired so they carry no mass
+                in_sample = mask_count > 0
+                U_eff = cegb_U | ~in_sample[:, None]
             for k in range(K):
                 gk = g if K == 1 else g[:, k]
                 hk = h if K == 1 else h[:, k]
@@ -863,7 +870,16 @@ class GBDT:
                     cegb_pen=cegb_pen, contri=self.feat_contri,
                     forced=self._forced_dev,
                     lazy=(None if cegb_U is None
-                          else (cegb_U, self._cegb_lazy)))
+                          else (U_eff, self._cegb_lazy)))
+                if cegb_U is not None:
+                    # class-k+1's tree sees class-k's acquisitions
+                    # (the reference trains per-class trees serially
+                    # and marks on split application)
+                    U_new = _cegb_u_fold(U_new, tree["leaf_used"],
+                                         leaf_id, in_sample)
+                    U_eff = U_new | ~in_sample[:, None]
+                    tree = {kk: v for kk, v in tree.items()
+                            if kk != "leaf_used"}
                 if use_quant and renew_quant:
                     # re-derive leaf outputs from FULL-precision sums
                     # (quant_train_renew_leaf)
@@ -890,7 +906,7 @@ class GBDT:
                 trees.append(tree)
                 leaf_ids.append(leaf_id)
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
-            return stacked, jnp.stack(leaf_ids), new_score
+            return stacked, jnp.stack(leaf_ids), new_score, U_new
 
         def step_impl(bins, bins_t, label, weight, score, mask_gh,
                       mask_count, allowed, cegb_pen, key, cegb_U=None):
@@ -1164,6 +1180,10 @@ class GBDT:
                 gcfg_c = _dc.replace(gcfg, hist_compact=True)
                 trees, leaf_ids = [], []
                 new_score = score
+                U_new = cegb_U
+                if cegb_U is not None:
+                    in_sample = sel
+                    U_eff = cegb_U | ~in_sample[:, None]
                 for k in range(K):
                     gk = g_c[:, k] * mgh_c
                     hk = h_c[:, k] * mgh_c
@@ -1184,7 +1204,13 @@ class GBDT:
                         compact=(bins_c, bins_t_c, vals_c),
                         forced=self._forced_dev,
                         lazy=(None if cegb_U is None
-                              else (cegb_U, self._cegb_lazy)))
+                              else (U_eff, self._cegb_lazy)))
+                    if cegb_U is not None:
+                        U_new = _cegb_u_fold(U_new, tree["leaf_used"],
+                                             leaf_id, in_sample)
+                        U_eff = U_new | ~in_sample[:, None]
+                        tree = {kk: v for kk, v in tree.items()
+                                if kk != "leaf_used"}
                     # FULL leaf ids came from the in-loop partition; the
                     # score update is the same one-hot matmul as the
                     # masked path (no per-row traversal)
@@ -1193,9 +1219,15 @@ class GBDT:
                     trees.append(tree)
                     leaf_ids.append(leaf_id)
                 stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
-                return stacked, jnp.stack(leaf_ids), new_score
+                return stacked, jnp.stack(leaf_ids), new_score, U_new
 
-            _compact_j = jax.jit(step_goss_compact_impl)
+            # donate cegb_U so the lazy-acquisition matrix updates in
+            # place ([n_pad, F_pad] bool — 2.5 GB at 10M x 256) instead
+            # of holding two copies across the step (CPU ignores
+            # donation with a warning, so gate on backend)
+            _don9 = ((9,) if jax.default_backend() == "tpu" else ())
+            _compact_j = jax.jit(step_goss_compact_impl,
+                                 donate_argnums=_don9)
 
             def _step_goss_compact(score, allowed, cegb_pen, key):
                 return _compact_j(dd.bins, dd.bins_t, dd.label,
@@ -1239,9 +1271,13 @@ class GBDT:
 
         if mesh is None:
             d = self.data
-            _step_j = jax.jit(step_impl)
-            _goss_j = jax.jit(step_goss_impl)
-            _custom_j = jax.jit(step_custom_impl)
+            _tpu = jax.default_backend() == "tpu"
+            _step_j = jax.jit(step_impl,
+                              donate_argnums=(10,) if _tpu else ())
+            _goss_j = jax.jit(step_goss_impl,
+                              donate_argnums=(9,) if _tpu else ())
+            _custom_j = jax.jit(step_custom_impl,
+                                donate_argnums=(10,) if _tpu else ())
 
             def step(score, mask_gh, mask_count, allowed, cegb_pen, key):
                 return _step_j(d.bins, d.bins_t, d.label, d.weight, score,
@@ -1272,7 +1308,7 @@ class GBDT:
                                 key, pos_state):
                     g, h, new_state = grads_state(score, label, weight,
                                                   pos_state)
-                    stacked, lids, ns = grow_all(
+                    stacked, lids, ns, _ = grow_all(
                         bins, bins_t, score, g, h, mask_gh,
                         mask_count, allowed,
                         qkey=jax.random.fold_in(key, 0x9e37),
@@ -1287,7 +1323,7 @@ class GBDT:
                                                   pos_state)
                     mask_gh, mask_count = goss_masks(g, h, valid_mask,
                                                      km)
-                    stacked, lids, ns = grow_all(
+                    stacked, lids, ns, _ = grow_all(
                         bins, bins_t, score, g, h, mask_gh,
                         mask_count, allowed,
                         qkey=jax.random.fold_in(key, 0x9e37),
@@ -1352,7 +1388,10 @@ class GBDT:
             if self.has_categorical:
                 tree_keys += ["is_cat", "cat_bitset"]
             tree_specs = {k: rep for k in tree_keys}
-            out_specs = (tree_specs, leaf_id_spec, row2)
+            # 4th output = cegb_U (always None under mesh — lazy CEGB
+            # requires the serial learner; the spec matches structure
+            # only, None carries no leaves)
+            out_specs = (tree_specs, leaf_id_spec, row2, None)
 
             w_spec = rep if d.weight is None else row1
             sharded_step = shard_map(
@@ -1435,16 +1474,18 @@ class GBDT:
             def chunk_impl(bins, bins_t, label, weight, score, valid_mask,
                            keys):
                 def body(sc, bkey):
+                    # lazy CEGB is chunk-ineligible (can_fuse_iters),
+                    # so the steps' cegb_U output is always None here
                     if goss and use_goss_compact:
-                        stacked, _lid, ns = step_goss_compact_impl(
+                        stacked, _lid, ns, _ = step_goss_compact_impl(
                             bins, bins_t, label, weight, valid_mask,
                             sc, allowed_all, None, bkey)
                     elif goss:
-                        stacked, _lid, ns = step_goss_impl(
+                        stacked, _lid, ns, _ = step_goss_impl(
                             bins, bins_t, label, weight, sc, valid_mask,
                             allowed_all, None, bkey)
                     else:
-                        stacked, _lid, ns = step_impl(
+                        stacked, _lid, ns, _ = step_impl(
                             bins, bins_t, label, weight, sc, valid_mask,
                             valid_mask, allowed_all, None, bkey)
                     return ns, stacked
@@ -1492,34 +1533,6 @@ class GBDT:
             m[self.data.n:] = True
             self._cegb_U = jnp.asarray(m)
         return self._cegb_U
-
-    def _cegb_lazy_update(self, leaf_ids) -> None:
-        """After a tree lands: rows acquire every feature on their leaf
-        path (cost_effective_gradient_boosting.hpp marks
-        feature-used-in-data on split application)."""
-        K = self.num_class
-        L = self.config.num_leaves
-        pf = np.zeros((K, L, self.F_pad), bool)
-        for k in range(K):
-            t = self.models[-K + k]
-            if not t.num_nodes:
-                continue
-            # leaf path features via parent walk over the host tree
-            feats = np.asarray(t.split_feature[:t.num_nodes])
-            lc = np.asarray(t.left_child[:t.num_nodes])
-            rc = np.asarray(t.right_child[:t.num_nodes])
-
-            def walk(node, used):
-                if node < 0:
-                    pf[k, -node - 1, list(used)] = True
-                    return
-                u2 = used | {int(feats[node])}
-                walk(int(lc[node]), u2)
-                walk(int(rc[node]), u2)
-
-            walk(0, set())
-        self._cegb_U = _cegb_u_update_j(self._cegb_U, leaf_ids,
-                                        jnp.asarray(pf))
 
     def _cegb_pen(self) -> Optional[jnp.ndarray]:
         """Per-feature coupled CEGB penalty ([F_pad]); zero for features
@@ -1608,13 +1621,15 @@ class GBDT:
                 except _checkify.JaxRuntimeError as e:
                     log.fatal(f"tpu_debug at iteration {self.iter_}: "
                               f"{e}")
+        cegb_U_new = None
         if grad is not None:
             mask_gh, mask_count = self._bagging_masks()
             g = self._pad_custom(grad)
             h = self._pad_custom(hess)
-            stacked, leaf_ids, new_score = self._step_custom(
-                self.score, g, h, mask_gh, mask_count, allowed,
-                self._cegb_pen(), key)
+            stacked, leaf_ids, new_score, cegb_U_new = \
+                self._step_custom(
+                    self.score, g, h, mask_gh, mask_count, allowed,
+                    self._cegb_pen(), key)
         elif goss_active:
             if self._pos_state is not None:
                 stacked, leaf_ids, new_score, self._pos_state = \
@@ -1622,11 +1637,13 @@ class GBDT:
                                           self._cegb_pen(), key,
                                           self._pos_state)
             elif self._step_goss_compact is not None:
-                stacked, leaf_ids, new_score = self._step_goss_compact(
-                    self.score, allowed, self._cegb_pen(), key)
+                stacked, leaf_ids, new_score, cegb_U_new = \
+                    self._step_goss_compact(
+                        self.score, allowed, self._cegb_pen(), key)
             else:
-                stacked, leaf_ids, new_score = self._step_goss(
-                    self.score, allowed, self._cegb_pen(), key)
+                stacked, leaf_ids, new_score, cegb_U_new = \
+                    self._step_goss(
+                        self.score, allowed, self._cegb_pen(), key)
         else:
             mask_gh, mask_count = self._bagging_masks()
             if self._pos_state is not None:
@@ -1635,7 +1652,7 @@ class GBDT:
                                      allowed, self._cegb_pen(), key,
                                      self._pos_state)
             else:
-                stacked, leaf_ids, new_score = self._step(
+                stacked, leaf_ids, new_score, cegb_U_new = self._step(
                     self.score, mask_gh, mask_count, allowed,
                     self._cegb_pen(), key)
         # start device->host copies of the (tiny) tree arrays immediately:
@@ -1670,8 +1687,11 @@ class GBDT:
             self.valid_scores = self._valid_update(self.valid_scores,
                                                    stacked)
         self._append_host_trees(self._fetch_tree_arrays(stacked))
-        if self._cegb_lazy is not None:
-            self._cegb_lazy_update(leaf_ids)
+        if cegb_U_new is not None:
+            # device-side acquisition fold already ran inside the step
+            # (_cegb_u_fold): in-sample rows acquired their leaf-path
+            # features for each class tree
+            self._cegb_U = cegb_U_new
         if self.linear_tree and grad is None:
             self._apply_linear_fit(leaf_ids, score_pre)
         if self.config.tpu_debug_checks:

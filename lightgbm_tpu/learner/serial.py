@@ -471,15 +471,21 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         lazy_U, lazy_pen = lazy
         notU = (1.0 - lazy_U.astype(jnp.float32)).astype(jnp.bfloat16)
 
-        def lazy_pen2(child_ids, lid_vec):
+        def lazy_pen2(child_ids, lid_vec, pathf=None):
             """[C] candidate leaf ids -> [C, F] lazy penalties:
             penalty[f] x #rows of the child that never acquired f
-            (0/1 bf16 operands, exact f32 accumulation)."""
+            (0/1 bf16 operands, exact f32 accumulation). ``pathf``
+            ([C, F] bool) marks features already split on the child's
+            path THIS tree: every row of the child acquired those on
+            split application (cost_effective_gradient_boosting.hpp),
+            so re-splitting them deeper is penalty-free."""
             mk = (lid_vec[:, None]
                   == child_ids[None, :]).astype(jnp.bfloat16)  # [n, C]
             cnt = jax.lax.dot_general(
                 mk, notU, dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)            # [C, F]
+            if pathf is not None:
+                cnt = cnt * (1.0 - pathf.astype(jnp.float32))
             return cnt * lazy_pen[None, :]
     else:
         lazy_pen2 = None
@@ -693,7 +699,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         leaf_lower=jnp.full(L + 1, -jnp.inf, jnp.float32),
         leaf_upper=jnp.full(L + 1, jnp.inf, jnp.float32),
         leaf_used=jnp.zeros(
-            (L + 1, F_meta if cfg.has_interaction else 1), jnp.bool_),
+            (L + 1, F_meta if (cfg.has_interaction or cfg.has_cegb_lazy)
+             else 1), jnp.bool_),
         mono_left=jnp.zeros(
             (L, L + 1) if use_mono_inter else (1, 1), jnp.bool_),
         mono_right=jnp.zeros(
@@ -1115,19 +1122,24 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             child_upper = jnp.concatenate([hi_l, hi_r])
         else:
             child_lower = child_upper = None
-        if cfg.has_interaction:
+        if cfg.has_interaction or cfg.has_cegb_lazy:
             fk = feat_sel
+            # only lanes that actually split extend their path set
             used_k = s.leaf_used[tl_safe] \
-                | (fk[:, None] == jnp.arange(F_meta, dtype=i32)[None, :])
+                | ((fk[:, None] == jnp.arange(F_meta, dtype=i32)[None, :])
+                   & valid[:, None])
+            child_used = jnp.concatenate([used_k, used_k])
+        else:
+            child_used = None
+        if cfg.has_interaction:
             # a group is usable iff it contains EVERY feature on the path
             viol = jnp.any(used_k[:, None, :] & ~groups[None],
                            axis=2)                            # [Kb, G]
             allow_k = jnp.any(groups[None] & ~viol[:, :, None],
                               axis=1) & allowed_feature[None]  # [Kb, F]
-            child_used = jnp.concatenate([used_k, used_k])
             child_allow = jnp.concatenate([allow_k, allow_k])
         else:
-            child_used = child_allow = None
+            child_allow = None
         if cfg.feature_fraction_bynode < 1.0 and node_key is not None:
             base = (child_allow if child_allow is not None
                     else jnp.broadcast_to(allowed_feature,
@@ -1145,6 +1157,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             mr = mr.at[node_ids, new_ids].set(True)
         else:
             ml, mr = s.mono_left, s.mono_right
+        ids2 = jnp.concatenate([tl_safe, new_ids])
         if use_mono_adv:
             # per-leaf feature bin ranges: children inherit the split
             # leaf's ranges; a NUMERICAL split narrows the split
@@ -1159,10 +1172,9 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 upd = upd & ~cat_sel[:, None]
             fhi_left = jnp.where(upd, thr_sel[:, None], fhi_p)
             flo_right = jnp.where(upd, thr_sel[:, None] + 1, flo_p)
-            ids2_r = jnp.concatenate([tl_safe, new_ids])
-            leaf_flo2 = s.leaf_flo.at[ids2_r].set(
+            leaf_flo2 = s.leaf_flo.at[ids2].set(
                 jnp.concatenate([flo_p, flo_right]))
-            leaf_fhi2 = s.leaf_fhi.at[ids2_r].set(
+            leaf_fhi2 = s.leaf_fhi.at[ids2].set(
                 jnp.concatenate([fhi_left, fhi_p]))
         else:
             leaf_flo2, leaf_fhi2 = s.leaf_flo, s.leaf_fhi
@@ -1170,7 +1182,6 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         # ---- best splits for all 2*Kb children -------------------------
         child_hists = jnp.concatenate([left_hist, right_hist])
         child_sums = jnp.concatenate([lsums, rsums])
-        ids2 = jnp.concatenate([tl_safe, new_ids])
         bests = search_best(child_hists, child_sums,
                             child_lower, child_upper, child_allow,
                             parent_outs=(jnp.concatenate([lvals, rvals])
@@ -1180,7 +1191,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                             depths=(jnp.concatenate([depth2, depth2])
                                     if cfg.monotone_penalty > 0.0
                                     else None),
-                            pen2=(lazy_pen2(ids2, leaf_id)
+                            pen2=(lazy_pen2(ids2, leaf_id, child_used)
                                   if lazy is not None else None))
 
         # ---- tree wiring -----------------------------------------------
@@ -1275,7 +1286,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             leaf_upper=(s.leaf_upper.at[ids2].set(child_upper)
                         if cfg.has_monotone else s.leaf_upper),
             leaf_used=(s.leaf_used.at[ids2].set(child_used)
-                       if cfg.has_interaction else s.leaf_used),
+                       if (cfg.has_interaction or cfg.has_cegb_lazy)
+                       else s.leaf_used),
             mono_left=ml,
             mono_right=mr,
             leaf_flo=leaf_flo2,
@@ -1323,4 +1335,10 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         # its per-row gathers — on pure-numerical datasets
         tree["is_cat"] = final.node_is_cat[:nn]
         tree["cat_bitset"] = final.node_cat_bitset[:nn]
+    if cfg.has_cegb_lazy:
+        # per-leaf path-feature sets ([L, F]): the boosting engine
+        # folds them into the per-row acquisition matrix device-side
+        # (rows acquire a feature when a split on it is applied above
+        # them — cost_effective_gradient_boosting.hpp)
+        tree["leaf_used"] = final.leaf_used[:L]
     return tree, final.leaf_id
