@@ -2037,6 +2037,9 @@ class GBDT:
                 colv[Xc.indices[sl]] = Xc.data[sl]
                 return colv
         else:
+            from ..io.dataset import apply_pandas_categorical
+            X = apply_pandas_categorical(
+                X, getattr(ds, "pandas_categorical", None))
             X = Dataset._to_matrix(X)
             n_rows = X.shape[0]
             if X.shape[1] != ds.num_total_features:

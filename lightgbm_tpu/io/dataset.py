@@ -31,6 +31,79 @@ def _host_mem_bytes():
         return None
 
 
+def _is_pandas_df(data) -> bool:
+    return (hasattr(data, "dtypes") and hasattr(data, "columns")
+            and hasattr(data, "values"))
+
+
+def _pandas_cat_columns(df) -> list:
+    return [c for c, dt in zip(df.columns, df.dtypes)
+            if str(dt) == "category"]
+
+
+def extract_pandas_categorical(df):
+    """Per category-dtype column (in column order), the category-value
+    list — the mapping stock LightGBM records as ``pandas_categorical``
+    in the model file (basic.py _data_from_pandas, UNVERIFIED — empty
+    mount). None when the frame has no category columns. Category
+    values must be JSON-serializable (they go into the model text
+    verbatim) — rejected HERE with a clear error rather than as a
+    TypeError at save time."""
+    cols = _pandas_cat_columns(df)
+    if not cols:
+        return None
+    import json
+    out = []
+    for c in cols:
+        cats = list(df[c].cat.categories.tolist())
+        try:
+            json.dumps(cats)
+        except TypeError:
+            log.fatal(
+                f"Categories of column '{c}' are not "
+                f"JSON-serializable (e.g. pd.cut Intervals or "
+                f"Timestamps) and cannot be stored in the model file — "
+                f"convert them to str or int first "
+                f"(e.g. df['{c}'] = df['{c}'].astype(str)"
+                f".astype('category'))")
+        out.append(cats)
+    return out
+
+
+def apply_pandas_categorical(data, pandas_categorical):
+    """Replace a DataFrame's category-dtype columns with their integer
+    CODES under ``pandas_categorical``'s category lists (float64; NaN
+    for missing AND for values outside the recorded lists). Train time
+    passes the frame's own lists; predict time passes the lists stored
+    in the model, so a frame whose categories arrive in a different
+    order — or with new values — still maps code-compatibly with
+    training. Non-DataFrame inputs pass through untouched."""
+    if not _is_pandas_df(data):
+        return data
+    cols = _pandas_cat_columns(data)
+    if not cols:
+        return data
+    if pandas_categorical is None or \
+            len(pandas_categorical) != len(cols):
+        log.fatal(
+            f"Input DataFrame has {len(cols)} category-dtype columns "
+            f"but the model/dataset records "
+            f"{0 if pandas_categorical is None else len(pandas_categorical)} "
+            f"— train and predict frames must have matching categorical "
+            f"columns (pandas_categorical)")
+    data = data.copy(deep=False)
+    for c, cats in zip(cols, pandas_categorical):
+        # vectorized value->code: set_categories drops values outside
+        # ``cats`` to NaN (code -1), exactly the unseen-category
+        # semantics of the bitset miss; at train time cats == the
+        # column's own list so this is the plain .cat.codes
+        codes = data[c].cat.set_categories(cats).cat.codes.to_numpy()
+        vals = codes.astype(np.float64)
+        vals[codes < 0] = np.nan
+        data[c] = vals
+    return data
+
+
 def _coerce_1d(a) -> np.ndarray:
     """1-D float64 coercion accepting numpy / lists / pandas Series /
     pyarrow Array-ChunkedArray (np.asarray would wrap arrow objects as
@@ -107,6 +180,9 @@ class Dataset:
         self.num_total_features = 0
         self.num_data = 0
         self._raw_for_linear: Optional[np.ndarray] = None
+        # category-value lists of pandas category-dtype columns
+        # (stock lightgbm's pandas_categorical); filled at construct
+        self.pandas_categorical = None
         import os as _os
         if isinstance(data, (str, _os.PathLike)):
             self._init_from_file(_os.fspath(data))
@@ -200,7 +276,17 @@ class Dataset:
             X = Xc          # find_bin_mappers handles sparse natively
             self.num_data, self.num_total_features = Xc.shape
         else:
-            X = self._to_matrix(self.data)
+            data = self.data
+            if _is_pandas_df(data) and _pandas_cat_columns(data):
+                # valid sets inherit the TRAINING frame's category
+                # lists so codes agree across datasets
+                self.pandas_categorical = (
+                    self.reference.construct().pandas_categorical
+                    if self.reference is not None
+                    else extract_pandas_categorical(data))
+                data = apply_pandas_categorical(
+                    data, self.pandas_categorical)
+            X = self._to_matrix(data)
             self.num_data, self.num_total_features = X.shape
         self._validate_metadata()
         names = self._resolve_feature_names(self.num_total_features)
@@ -690,6 +776,7 @@ class Dataset:
         sub.free_raw_data = self.free_raw_data
         sub.feature_name = self.feature_name
         sub.categorical_feature = self.categorical_feature
+        sub.pandas_categorical = self.pandas_categorical
         sub.metadata = Metadata()
         md = self.metadata
         if md.label is not None:

@@ -45,6 +45,9 @@ class HostModel:
     params: Dict[str, str] = dataclasses.field(default_factory=dict)
     # per-node missing type codes per tree (parallel to split arrays)
     missing_types: Optional[List[np.ndarray]] = None
+    # category-value lists for pandas category-dtype input columns
+    # (stock lightgbm's pandas_categorical model-file field)
+    pandas_categorical: Optional[list] = None
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -134,6 +137,7 @@ class HostModel:
                     "max_bin": config.max_bin,
                     "boosting": config.boosting},
             missing_types=missing_types,
+            pandas_categorical=getattr(ds, "pandas_categorical", None),
         )
 
     # ------------------------------------------------------------------
@@ -146,6 +150,8 @@ class HostModel:
                 pred_early_stop_margin: float = 10.0,
                 contrib_force_f64=None) -> np.ndarray:
         from .dataset import Dataset as _DS
+        from .dataset import apply_pandas_categorical
+        data = apply_pandas_categorical(data, self.pandas_categorical)
         if hasattr(data, "tocsr") and not isinstance(data, np.ndarray) \
                 and data.shape[0] > 0:
             # scipy sparse: densify in bounded row chunks (linear
@@ -364,7 +370,9 @@ def save_model_string(model: HostModel,
     out += "\nparameters:\n"
     for k, v in model.params.items():
         out += f"[{k}: {v}]\n"
-    out += "end of parameters\n\npandas_categorical:null\n"
+    import json as _json
+    out += ("end of parameters\n\npandas_categorical:"
+            + _json.dumps(model.pandas_categorical) + "\n")
     return out
 
 
@@ -619,6 +627,16 @@ def load_model_string(text: str) -> HostModel:
         t.node_missing_type = mt
         trees.append(t)
         missing_types.append(mt)
+    pandas_categorical = None
+    marker = "\npandas_categorical:"
+    if marker in text:
+        import json as _json
+        line = text.split(marker, 1)[1].split("\n", 1)[0].strip()
+        if line:
+            try:
+                pandas_categorical = _json.loads(line)
+            except ValueError:
+                log.warning("Malformed pandas_categorical field ignored")
     return HostModel(
         trees=trees,
         num_class=int(kv.get("num_class", 1)),
@@ -630,4 +648,5 @@ def load_model_string(text: str) -> HostModel:
         label_index=int(kv.get("label_index", 0)),
         average_output="average_output" in head,
         missing_types=missing_types,
+        pandas_categorical=pandas_categorical,
     )

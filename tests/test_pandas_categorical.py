@@ -1,0 +1,161 @@
+"""pandas category-dtype interop (stock lightgbm's pandas_categorical).
+
+Reference: python-package/lightgbm/basic.py _data_from_pandas +
+pandas_categorical model-file field (UNVERIFIED — empty mount, see
+SURVEY.md banner): category columns train on their integer codes, the
+category-value lists are stored in the model, and predict-time frames
+are remapped BY VALUE through the stored lists so category order or
+new unseen values cannot silently shift codes.
+"""
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.dataset import (apply_pandas_categorical,
+                                     extract_pandas_categorical)
+
+
+def _frame(n=3000, seed=7):
+    rng = np.random.default_rng(seed)
+    color = rng.choice(["red", "green", "blue", "mauve"], size=n)
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    y = ((color == "red") * 1.5 + (color == "mauve") * -1.0
+         + x0 + rng.normal(scale=0.3, size=n) > 0.5).astype(np.float64)
+    df = pd.DataFrame({
+        "color": pd.Categorical(color),
+        "x0": x0,
+        "x1": x1,
+    })
+    return df, y
+
+
+def _simple_auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    npos = y.sum()
+    nneg = len(y) - npos
+    return (ranks[y > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+def test_category_column_carries_signal():
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(df, label=y),
+                    num_boost_round=15)
+    assert _simple_auc(y, bst.predict(df)) > 0.85
+    # auto-detection made the column categorical: some tree splits it
+    imp = dict(zip(bst.feature_name(), bst.feature_importance()))
+    assert imp.get("color", 0) > 0
+
+
+def test_predict_reordered_categories_matches():
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(df, label=y),
+                    num_boost_round=10)
+    base = bst.predict(df)
+    # same VALUES, different category order and dtype declaration —
+    # remapping by value must give identical predictions
+    df2 = df.copy()
+    df2["color"] = pd.Categorical(
+        np.asarray(df["color"]),
+        categories=["mauve", "blue", "green", "red"])
+    np.testing.assert_allclose(bst.predict(df2), base,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_unseen_category_routes_like_missing():
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(df, label=y),
+                    num_boost_round=10)
+    df2 = df.iloc[:200].copy()
+    df2["color"] = pd.Categorical(
+        ["chartreuse"] * 200,
+        categories=list(df["color"].cat.categories) + ["chartreuse"])
+    # unseen value -> NaN code -> bitset miss -> same as NaN input
+    df3 = df.iloc[:200].copy()
+    df3["color"] = pd.Categorical(
+        [None] * 200, categories=df["color"].cat.categories)
+    np.testing.assert_allclose(bst.predict(df2), bst.predict(df3),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_model_text_roundtrip_keeps_mapping():
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(df, label=y),
+                    num_boost_round=8)
+    s = bst.model_to_string()
+    assert "pandas_categorical:[[" in s
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst2.predict(df), bst.predict(df),
+                               rtol=1e-5, atol=1e-6)
+    # and the loaded model still remaps reordered frames by value
+    df2 = df.copy()
+    df2["color"] = pd.Categorical(
+        np.asarray(df["color"]),
+        categories=["blue", "red", "mauve", "green"])
+    np.testing.assert_allclose(bst2.predict(df2), bst2.predict(df),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_valid_set_shares_training_mapping():
+    df, y = _frame()
+    tr, va = df.iloc[:2000], df.iloc[2000:]
+    ytr, yva = y[:2000], y[2000:]
+    # give the valid frame a different category order on purpose
+    va = va.copy()
+    va["color"] = pd.Categorical(
+        np.asarray(va["color"]),
+        categories=["green", "mauve", "red", "blue"])
+    ds = lgb.Dataset(tr, label=ytr)
+    vs = ds.create_valid(va, label=yva)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "metric": "auc", "verbosity": -1}, ds,
+                    num_boost_round=10, valid_sets=[vs],
+                    valid_names=["va"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    # the valid AUC only makes sense if codes agree across frames
+    assert evals["va"]["auc"][-1] > 0.8
+    np.testing.assert_allclose(
+        bst.predict(va), bst.predict(df.iloc[2000:]),
+        rtol=1e-12, atol=1e-12)
+
+
+def test_mismatched_cat_columns_fatal():
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(df[["x0", "x1"]], label=y),
+                    num_boost_round=3)
+    bad = df.copy()[["color", "x0"]]
+    with pytest.raises(Exception, match="category-dtype"):
+        bst.predict(bad)
+
+
+def test_interval_categories_rejected_at_construct():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=500)
+    df = pd.DataFrame({"b": pd.cut(x, 4), "x": x})
+    y = (x > 0).astype(np.float64)
+    with pytest.raises(Exception, match="JSON-serializable"):
+        lgb.train({"objective": "binary", "verbosity": -1},
+                  lgb.Dataset(df, label=y), num_boost_round=2)
+
+
+def test_helpers_roundtrip():
+    df, _ = _frame(n=50)
+    cats = extract_pandas_categorical(df)
+    assert cats == [list(df["color"].cat.categories)]
+    out = apply_pandas_categorical(df, cats)
+    col = np.asarray(out["color"], dtype=np.float64)
+    assert np.nanmax(col) <= len(cats[0]) - 1
+    # plain arrays pass through untouched
+    arr = np.zeros((3, 2))
+    assert apply_pandas_categorical(arr, None) is arr
