@@ -27,6 +27,14 @@ import numpy as np
 
 from ..utils import log
 
+
+def _native():
+    '''Native binning library, or None (pure-Python fallback). A
+    function (not a cached global) so tests can monkeypatch it off.'''
+    from ..native import binning
+    return binning()
+
+
 K_ZERO_THRESHOLD = 1e-35
 BIN_TYPE_NUMERICAL = "numerical"
 BIN_TYPE_CATEGORICAL = "categorical"
@@ -48,6 +56,19 @@ def _greedy_find_distinct_bounds(distinct_values: np.ndarray,
     to roughly ``mean_bin_size`` each.
     """
     n_distinct = len(distinct_values)
+    lib = _native()
+    if lib is not None and n_distinct > 4096:
+        import ctypes
+        dv = np.ascontiguousarray(distinct_values, dtype=np.float64)
+        cn = np.ascontiguousarray(counts, dtype=np.int64)
+        out = np.empty(int(max_bin) + 2, dtype=np.float64)
+        n_out = lib.greedy_find_bounds(
+            dv.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            cn.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n_distinct, int(max_bin), int(total_cnt),
+            int(min_data_in_bin),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return list(out[:n_out])
     bounds: List[float] = []
     if n_distinct == 0:
         return [np.inf]
@@ -299,7 +320,32 @@ class BinMapper:
 
     # ------------------------------------------------------------------
     def values_to_bins(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized value→bin for a full column (NaN-aware)."""
+        """Vectorized value→bin for a full column (NaN-aware). Large
+        numerical columns take the single-pass native path (f32
+        accepted WITHOUT the float64 copy; strided column views of a
+        row-major matrix bin in place)."""
+        raw = np.asarray(values)
+        if self.bin_type != BIN_TYPE_CATEGORICAL \
+                and raw.ndim == 1 and len(raw) > 65536 \
+                and raw.dtype in (np.float32, np.float64) \
+                and raw.strides[0] > 0:
+            lib = _native()
+            if lib is not None:
+                import ctypes
+                ub = np.ascontiguousarray(self.bin_upper_bound,
+                                          dtype=np.float64)
+                out = np.empty(len(raw), dtype=np.int32)
+                mt = {MISSING_NONE: 0, MISSING_ZERO: 1,
+                      MISSING_NAN: 2}[self.missing_type]
+                lib.bin_numeric_column(
+                    raw.ctypes.data_as(ctypes.c_void_p),
+                    int(raw.dtype == np.float32),
+                    len(raw), raw.strides[0] // raw.itemsize,
+                    ub.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    len(ub), mt, int(self.default_bin),
+                    int(self.num_bin),
+                    out.ctypes.data_as(ctypes.c_void_p), 2, 1)
+                return out
         values = np.asarray(values, dtype=np.float64)
         if self.bin_type == BIN_TYPE_CATEGORICAL:
             out = np.zeros(len(values), dtype=np.int32)

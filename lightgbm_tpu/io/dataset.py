@@ -286,7 +286,20 @@ class Dataset:
                     else extract_pandas_categorical(data))
                 data = apply_pandas_categorical(
                     data, self.pandas_categorical)
-            X = self._to_matrix(data)
+            from ..config import coerce_bool as _cb2
+            if (isinstance(data, np.ndarray) and data.ndim == 2
+                    and data.dtype in (np.float32, np.float64)
+                    and not _cb2(self.params.get("linear_tree", False))):
+                # fast path: bin columns of the caller's matrix
+                # directly (the native binner takes f32 and strided
+                # views) instead of materializing a float64 copy —
+                # at 10M x 28 that copy alone is ~2.2 GB. Bin mappers
+                # still see float64 (from_sample converts its sample).
+                # linear_tree keeps the f64 path: leaf ridge fits read
+                # _raw_for_linear and must match predict-time f64.
+                X = data
+            else:
+                X = self._to_matrix(data)
             self.num_data, self.num_total_features = X.shape
         self._validate_metadata()
         names = self._resolve_feature_names(self.num_total_features)
@@ -328,18 +341,7 @@ class Dataset:
                             "the provided configuration.")
 
         dtype = self._binned_dtype_with_guard()
-        cols = []
-        for f in self.used_features:
-            if is_sparse:
-                colv = np.zeros(self.num_data, np.float64)
-                sl = slice(Xc.indptr[f], Xc.indptr[f + 1])
-                colv[Xc.indices[sl]] = Xc.data[sl]
-            else:
-                colv = X[:, f]
-            cols.append(self.bin_mappers[f].values_to_bins(colv)
-                        .astype(dtype))
-        self.binned = (np.stack(cols, axis=1) if cols
-                       else np.zeros((self.num_data, 0), dtype=dtype))
+        self.binned = self._bin_all_columns(X, is_sparse, dtype)
         from ..config import coerce_bool as _cb
         if _cb(self.params.get("linear_tree", False)):
             if is_sparse:
@@ -350,6 +352,80 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _bin_all_columns(self, X, is_sparse: bool, dtype) -> np.ndarray:
+        """Pack the binned matrix [n, n_used]. Dense row-major input
+        takes ONE native row-major pass over all numeric columns
+        (native/binning.cpp bin_matrix — column-at-a-time binning
+        cache-misses every strided read); categorical columns and the
+        fallbacks go per-column."""
+        used = self.used_features
+        if not used:
+            return np.zeros((self.num_data, 0), dtype=dtype)
+        from .binning import _native
+        lib = _native()
+        dense_fast = (lib is not None and not is_sparse
+                      and isinstance(X, np.ndarray) and X.ndim == 2
+                      and X.dtype in (np.float32, np.float64)
+                      and X.flags.c_contiguous
+                      and self.num_data > 65536)
+        if dense_fast:
+            import ctypes
+            n_cols = len(used)
+            is_num = np.array(
+                [self.bin_mappers[f].bin_type != BIN_TYPE_CATEGORICAL
+                 for f in used], dtype=np.int32)
+            ubs = [np.ascontiguousarray(
+                       self.bin_mappers[f].bin_upper_bound
+                       if is_num[j] else np.zeros(1), dtype=np.float64)
+                   for j, f in enumerate(used)]
+            ub_off = np.zeros(n_cols + 1, dtype=np.int64)
+            np.cumsum([len(u) for u in ubs], out=ub_off[1:])
+            ub_concat = np.concatenate(ubs)
+            mt_code = {"none": 0, "zero": 1, "nan": 2}
+            meta_mt = np.array(
+                [mt_code.get(self.bin_mappers[f].missing_type, 0)
+                 for f in used], dtype=np.int32)
+            meta_db = np.array(
+                [self.bin_mappers[f].default_bin for f in used],
+                dtype=np.int64)
+            meta_nb = np.array(
+                [self.bin_mappers[f].num_bin for f in used],
+                dtype=np.int64)
+            col_idx = np.array(used, dtype=np.int64)
+            out = np.empty((self.num_data, n_cols), dtype=dtype)
+            out_kind = {np.uint8: 0, np.uint16: 1,
+                        np.int32: 2}[np.dtype(dtype).type]
+            c = ctypes
+            lib.bin_matrix(
+                X.ctypes.data_as(c.c_void_p),
+                int(X.dtype == np.float32), self.num_data,
+                X.strides[0] // X.itemsize,
+                col_idx.ctypes.data_as(c.POINTER(c.c_int64)), n_cols,
+                ub_concat.ctypes.data_as(c.POINTER(c.c_double)),
+                ub_off.ctypes.data_as(c.POINTER(c.c_int64)),
+                meta_mt.ctypes.data_as(c.POINTER(c.c_int32)),
+                meta_db.ctypes.data_as(c.POINTER(c.c_int64)),
+                meta_nb.ctypes.data_as(c.POINTER(c.c_int64)),
+                is_num.ctypes.data_as(c.POINTER(c.c_int32)),
+                out.ctypes.data_as(c.c_void_p), out_kind)
+            for j, f in enumerate(used):     # categorical remainder
+                if not is_num[j]:
+                    out[:, j] = self.bin_mappers[f].values_to_bins(
+                        X[:, f]).astype(dtype)
+            return out
+        cols = []
+        for f in used:
+            if is_sparse:
+                # X is the CSC matrix here (construct passes it through)
+                colv = np.zeros(self.num_data, np.float64)
+                sl = slice(X.indptr[f], X.indptr[f + 1])
+                colv[X.indices[sl]] = X.data[sl]
+            else:
+                colv = X[:, f]
+            cols.append(self.bin_mappers[f].values_to_bins(colv)
+                        .astype(dtype))
+        return np.stack(cols, axis=1)
 
     # ------------------------------------------------------------------
     def _binned_dtype_with_guard(self):

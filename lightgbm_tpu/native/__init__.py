@@ -206,6 +206,35 @@ class CBooster:
         return self._get_int("GetNumTreePerIteration")
 
 
+def binning() -> Optional[ctypes.CDLL]:
+    """Native binning hot paths (greedy bound search + bin apply);
+    bit-exact ports of io/binning.py's Python implementations, which
+    remain the fallback."""
+    lib = load_native("binning.cpp")
+    if lib is None:
+        return None
+    if not getattr(lib, "_sigs_set", False):
+        c = ctypes
+        lib.greedy_find_bounds.restype = c.c_int64
+        lib.greedy_find_bounds.argtypes = [
+            c.POINTER(c.c_double), c.POINTER(c.c_int64), c.c_int64,
+            c.c_int64, c.c_int64, c.c_int64, c.POINTER(c.c_double)]
+        lib.bin_numeric_column.restype = None
+        lib.bin_numeric_column.argtypes = [
+            c.c_void_p, c.c_int, c.c_int64, c.c_int64,
+            c.POINTER(c.c_double), c.c_int64, c.c_int, c.c_int64,
+            c.c_int64, c.c_void_p, c.c_int, c.c_int64]
+        lib.bin_matrix.restype = None
+        lib.bin_matrix.argtypes = [
+            c.c_void_p, c.c_int, c.c_int64, c.c_int64,
+            c.POINTER(c.c_int64), c.c_int64, c.POINTER(c.c_double),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+            c.POINTER(c.c_int), c.c_void_p, c.c_int]
+        lib._sigs_set = True
+    return lib
+
+
 def text_parser() -> Optional[ctypes.CDLL]:
     lib = load_native("text_parser.cpp")
     if lib is None:
