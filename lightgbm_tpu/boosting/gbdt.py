@@ -2050,10 +2050,12 @@ class GBDT:
 
             def _col(f):
                 return X[:, f]
-        cols = [ds.bin_mappers[f].values_to_bins(_col(f))
-                for f in ds.used_features]
-        bins = (np.stack(cols, axis=1).astype(ds.binned.dtype)
-                if cols else np.zeros((n_rows, 0), ds.binned.dtype))
+        # one native row-major pass over all columns where possible
+        # (Dataset._bin_all_columns; the strided per-column fallback
+        # otherwise) — same binning the training construct used
+        src = Xc if sparse_in else X
+        bins = ds._bin_all_columns(src, sparse_in, ds.binned.dtype,
+                                   n_rows=n_rows)
         total_iters = len(self.models) // self.num_class
         if num_iteration <= 0:
             num_iteration = total_iters - start_iteration

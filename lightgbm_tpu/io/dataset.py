@@ -353,22 +353,25 @@ class Dataset:
             self.data = None
         return self
 
-    def _bin_all_columns(self, X, is_sparse: bool, dtype) -> np.ndarray:
+    def _bin_all_columns(self, X, is_sparse: bool, dtype,
+                         n_rows: int = None) -> np.ndarray:
         """Pack the binned matrix [n, n_used]. Dense row-major input
         takes ONE native row-major pass over all numeric columns
         (native/binning.cpp bin_matrix — column-at-a-time binning
         cache-misses every strided read); categorical columns and the
         fallbacks go per-column."""
         used = self.used_features
+        if n_rows is None:
+            n_rows = self.num_data
         if not used:
-            return np.zeros((self.num_data, 0), dtype=dtype)
+            return np.zeros((n_rows, 0), dtype=dtype)
         from .binning import _native
         lib = _native()
         dense_fast = (lib is not None and not is_sparse
                       and isinstance(X, np.ndarray) and X.ndim == 2
                       and X.dtype in (np.float32, np.float64)
                       and X.flags.c_contiguous
-                      and self.num_data > 65536)
+                      and n_rows > 65536)
         if dense_fast:
             import ctypes
             n_cols = len(used)
@@ -393,13 +396,13 @@ class Dataset:
                 [self.bin_mappers[f].num_bin for f in used],
                 dtype=np.int64)
             col_idx = np.array(used, dtype=np.int64)
-            out = np.empty((self.num_data, n_cols), dtype=dtype)
+            out = np.empty((n_rows, n_cols), dtype=dtype)
             out_kind = {np.uint8: 0, np.uint16: 1,
                         np.int32: 2}[np.dtype(dtype).type]
             c = ctypes
             lib.bin_matrix(
                 X.ctypes.data_as(c.c_void_p),
-                int(X.dtype == np.float32), self.num_data,
+                int(X.dtype == np.float32), n_rows,
                 X.strides[0] // X.itemsize,
                 col_idx.ctypes.data_as(c.POINTER(c.c_int64)), n_cols,
                 ub_concat.ctypes.data_as(c.POINTER(c.c_double)),
@@ -418,7 +421,7 @@ class Dataset:
         for f in used:
             if is_sparse:
                 # X is the CSC matrix here (construct passes it through)
-                colv = np.zeros(self.num_data, np.float64)
+                colv = np.zeros(n_rows, np.float64)
                 sl = slice(X.indptr[f], X.indptr[f + 1])
                 colv[X.indices[sl]] = X.data[sl]
             else:
