@@ -2019,7 +2019,8 @@ class GBDT:
                                     num_iteration=num_iteration,
                                     pred_leaf=pred_leaf)
         ds = self.train_set
-        if hasattr(X, "tocsc") and not isinstance(X, np.ndarray):
+        sparse_in = hasattr(X, "tocsc") and not isinstance(X, np.ndarray)
+        if sparse_in:
             # scipy sparse: bin column-at-a-time without densifying the
             # full matrix (same path training binning uses — Criteo-
             # scale sparse predict must not materialize n x F floats)
@@ -2030,12 +2031,6 @@ class GBDT:
                     f"The number of features in data ({Xc.shape[1]}) is "
                     f"not the same as it was in training data "
                     f"({ds.num_total_features})")
-
-            def _col(f):
-                colv = np.zeros(n_rows, np.float64)
-                sl = slice(Xc.indptr[f], Xc.indptr[f + 1])
-                colv[Xc.indices[sl]] = Xc.data[sl]
-                return colv
         else:
             from ..io.dataset import apply_pandas_categorical
             X = apply_pandas_categorical(
@@ -2047,9 +2042,6 @@ class GBDT:
                     f"The number of features in data ({X.shape[1]}) is "
                     f"not the same as it was in training data "
                     f"({ds.num_total_features})")
-
-            def _col(f):
-                return X[:, f]
         # one native row-major pass over all columns where possible
         # (Dataset._bin_all_columns; the strided per-column fallback
         # otherwise) — same binning the training construct used

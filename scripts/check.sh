@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Pre-snapshot gate (VERDICT r4 item 1): a <2-minute smoke that MUST be
+# green before any end-of-round snapshot or milestone commit.
+#
+#   bash scripts/check.sh          # smoke tests + tiny bench
+#   bash scripts/check.sh --full   # full suite instead of the smoke set
+#
+# Rationale: round 4's final commit shipped an undefined variable in
+# GBDT.predict() that failed 111/249 tests and blanked BENCH_r04. This
+# script is the discipline that prevents a recurrence.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--full" ]]; then
+  python -m pytest tests/ -x -q
+else
+  python -m pytest tests/test_smoke_gate.py tests/test_engine.py -x -q
+fi
+
+# tiny bench: exercises the real flagship path end to end (train +
+# predict + AUC) and proves bench.py emits its JSON line with rc=0
+python bench.py --rows 300000 --iters 5 --smoke
+echo "check.sh: OK"
