@@ -199,6 +199,14 @@ bool ParseTree(const std::string& block, NativeTree* t,
   const std::string* s = kv.Get("num_leaves");
   if (!s) return false;
   t->num_leaves = std::atoi(s->c_str());
+  // range-check BEFORE any sizing: a corrupt count (negative, or
+  // larger than the block could possibly serialize — every array
+  // entry is >=1 char) must not reach vector::resize, where it would
+  // throw length_error/bad_alloc across the extern-C boundary
+  if (t->num_leaves < 1 ||
+      static_cast<size_t>(t->num_leaves) > block.size()) {
+    return false;
+  }
   const int nn = t->num_leaves > 1 ? t->num_leaves - 1 : 0;
 
   // strict parsing: a present array must tokenize cleanly and carry
@@ -241,8 +249,10 @@ bool ParseTree(const std::string& block, NativeTree* t,
 
   const std::string* nc = kv.Get("num_cat");
   if (nc && std::atoi(nc->c_str()) > 0) {
+    const int ncat = std::atoi(nc->c_str());
+    if (static_cast<size_t>(ncat) > block.size()) return false;
     std::vector<int32_t> cb;
-    geti("cat_boundaries", std::atoi(nc->c_str()) + 1, &cb);
+    geti("cat_boundaries", ncat + 1, &cb);
     t->cat_boundaries.assign(cb.begin(), cb.end());
     const std::string* ct = kv.Get("cat_threshold");
     std::vector<double> ctd;
@@ -303,9 +313,12 @@ bool ParseTree(const std::string& block, NativeTree* t,
       return false;
     }
     if (t->decision_type[i] & 1) {
+      // compare in floating point BEFORE casting: double->size_t on a
+      // value outside size_t's range is undefined behavior, so a
+      // corrupt threshold like 1e300 must be rejected pre-cast
       const double ci = t->threshold[i];
       if (!(ci >= 0) || t->cat_boundaries.empty() ||
-          static_cast<size_t>(ci) + 1 >= t->cat_boundaries.size()) {
+          ci + 1 >= static_cast<double>(t->cat_boundaries.size())) {
         return false;
       }
     }
@@ -334,6 +347,18 @@ NativeBooster* ParseModel(const std::string& text) {
     booster->max_feature_idx = std::atoi(v->c_str());
   if (const std::string* v = hkv.Get("objective"))
     booster->objective = *v;
+  // header sanity: corrupt counts must error here, not size buffers or
+  // index arrays later (reference hardens with CHECK macros; SURVEY
+  // §2.1 utils row, UNVERIFIED)
+  if (booster->num_class < 1 || booster->num_class > (1 << 20) ||
+      booster->num_tree_per_iteration < 1 ||
+      booster->num_tree_per_iteration > (1 << 20) ||
+      booster->max_feature_idx < 0 ||
+      booster->max_feature_idx >= (1 << 28)) {
+    SetError("Malformed model header counts");
+    delete booster;
+    return nullptr;
+  }
   booster->average_output =
       head.find("\naverage_output") != std::string::npos;
 
@@ -421,7 +446,16 @@ int LGBMTPU_BoosterLoadModelFromString(const char* model_str,
     SetError("null argument");
     return -1;
   }
-  NativeBooster* b = ParseModel(model_str);
+  // last-resort exception fence: no C++ exception (bad_alloc,
+  // length_error from a corrupt count that slipped past validation)
+  // may cross the C ABI — that is std::terminate in the caller
+  NativeBooster* b = nullptr;
+  try {
+    b = ParseModel(model_str);
+  } catch (const std::exception& e) {
+    SetError(std::string("Malformed model string (") + e.what() + ")");
+    return -1;
+  }
   if (!b) return -1;
   if (out_num_iterations) *out_num_iterations = b->NumIterations();
   *out_handle = b;

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# ASAN/UBSAN fuzz of the C ABI model parser (VERDICT r4 item 5).
+#
+# Builds native/c_api.cpp + native/fuzz_main.cpp with
+# -fsanitize=address,undefined, generates the truncation/bit-flip
+# corpus via the Python helper, and runs every file through the
+# driver. Any OOB read, UB, leak, or crash exits nonzero.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BUILD=$(mktemp -d)
+trap 'rm -rf "$BUILD"' EXIT
+
+g++ -O1 -g -std=c++17 -fsanitize=address,undefined \
+    -fno-omit-frame-pointer -fopenmp \
+    lightgbm_tpu/native/c_api.cpp lightgbm_tpu/native/fuzz_main.cpp \
+    -o "$BUILD/fuzz_main"
+
+python - "$BUILD" << 'EOF'
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.getcwd())
+import lightgbm_tpu as lgb
+rng = np.random.default_rng(23)
+X = rng.normal(size=(400, 5))
+y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+     + rng.normal(scale=0.3, size=400) > 0).astype(np.float64)
+Xc = X.copy(); Xc[:, 4] = np.floor(np.abs(Xc[:, 4]) * 7) % 12
+ds = lgb.Dataset(Xc, label=y, categorical_feature=[4])
+bst = lgb.train({"verbosity": -1, "num_leaves": 15,
+                 "objective": "binary"}, ds, num_boost_round=4)
+s = bst.model_to_string()
+out = sys.argv[1]
+corpus = []
+for cut in np.linspace(10, len(s) - 1, 60).astype(int):
+    corpus.append(s[:cut])
+body = s.find("Tree=")
+rng = np.random.default_rng(99)
+for _ in range(300):
+    pos = int(rng.integers(body, len(s)))
+    ch = chr(int(rng.integers(32, 127)))
+    corpus.append(s[:pos] + ch + s[pos + 1:])
+for tok in ("threshold=", "cat_boundaries=", "left_child=",
+            "split_feature=", "num_leaves=", "num_cat="):
+    corpus.append(s.replace(tok, tok + "1e300 ", 1))
+    corpus.append(s.replace(tok, tok + "-999999999 ", 1))
+for i, m in enumerate(corpus):
+    with open(os.path.join(out, f"m{i:04d}.txt"), "w") as f:
+        f.write(m)
+print(f"corpus: {len(corpus)} files")
+EOF
+
+"$BUILD/fuzz_main" "$BUILD"/m*.txt
+echo "fuzz_c_api: OK (ASAN+UBSAN clean)"
