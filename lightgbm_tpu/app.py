@@ -48,6 +48,34 @@ def parse_cli_args(argv: List[str]) -> Dict[str, Any]:
     return params
 
 
+def _cli_file_shard(data_path: str, params: Dict[str, Any],
+                    rank: int, nproc: int):
+    """Per-worker shard loader for the distributed CLI: every process
+    parses the data file (each machine reads the file in the
+    reference's pre-partition=false mode) and keeps its contiguous row
+    slice. Module-level so spawn can pickle it via functools.partial."""
+    from .io.text_loader import load_text
+    loaded = load_text(
+        data_path,
+        label_column=params.get("label_column", "auto"),
+        weight_column=params.get("weight_column"),
+        group_column=params.get("group_column"),
+        ignore_column=params.get("ignore_column"))
+    if loaded.group is not None:
+        log.fatal("num_machines>1 does not shard ranking groups; "
+                  "use lightgbm_tpu.run_worker with a group-aligned "
+                  "data_fn")
+    n = len(loaded.X)
+    blk = n // nproc
+    lo = rank * blk
+    hi = n if rank == nproc - 1 else lo + blk
+    return {"data": loaded.X[lo:hi],
+            "label": None if loaded.label is None
+            else loaded.label[lo:hi],
+            "weight": None if loaded.weight is None
+            else loaded.weight[lo:hi]}
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     params = parse_cli_args(list(sys.argv[1:] if argv is None else argv))
     task = str(params.pop("task", "train")).lower()
@@ -89,6 +117,32 @@ def run(argv: Optional[List[str]] = None) -> int:
     if task == "train":
         if data_path is None:
             log.fatal("No training data: pass data=FILE")
+        # distributed CLI (application.cpp's Network::Init-from-config
+        # flow, SURVEY §3.2 — UNVERIFIED): num_machines=N forks N
+        # localhost jax.distributed workers, each loading the data file
+        # and keeping its contiguous row shard (the reference's
+        # rank-aware pre_partition load). Real pods should call
+        # lightgbm_tpu.run_worker once per host instead — the machine
+        # list lives in jax.distributed, not a machine_list file.
+        n_machines = int(params.pop(
+            "num_machines", params.pop("num_machine", 1)))
+        if n_machines > 1:
+            if valid_spec:
+                log.warning("valid sets are ignored under "
+                            "num_machines>1 (evaluate task=predict "
+                            "on the saved model instead)")
+            from functools import partial
+
+            from .parallel.launch import train_distributed
+            data_fn = partial(_cli_file_shard, data_path, dict(params))
+            bst = train_distributed(params, data_fn,
+                                    n_processes=n_machines,
+                                    num_boost_round=num_round)
+            bst.save_model(output_model)
+            log.info(f"Finished distributed training "
+                     f"({n_machines} processes); model saved to "
+                     f"{output_model}")
+            return 0
         ds = Dataset(data_path, params=dict(params))
         valid_sets, valid_names = [], []
         if valid_spec:

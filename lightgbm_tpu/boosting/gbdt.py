@@ -68,13 +68,32 @@ class _DeviceData:
 
     def __init__(self, ds: Dataset, rows_per_block: int, mesh=None,
                  transposed: bool = False, shard_features: bool = False,
-                 n_feature_pad: int = 0, binned_override=None):
+                 n_feature_pad: int = 0, binned_override=None,
+                 n_layout: int = None):
         ds.construct()
         self.n = ds.num_data
         # feature-parallel replicates rows; data/voting shard them
         row_shards = (mesh.devices.size
                       if mesh is not None and not shard_features else 1)
-        self.n_pad = pad_rows(self.n, rows_per_block * row_shards)
+        # multi-host placement requires every process to contribute the
+        # SAME padded chunk shape (make_array_from_process_local_data);
+        # with uneven shards (e.g. the distributed CLI's remainder on
+        # the last rank) the pad target must be the LARGEST local shard,
+        # agreed via a host-side counts allgather — otherwise shapes
+        # (and thus the traced SPMD programs) diverge across processes.
+        # The caller passes n_layout when it already gathered the max
+        # (GBDT.__init__ does, for rows_per_block); valid sets gather
+        # their own here.
+        if n_layout is None:
+            n_layout = self.n
+            if (mesh is not None and not shard_features
+                    and jax.process_count() > 1):
+                from jax.experimental import multihost_utils
+                g = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([self.n], np.int64)))
+                n_layout = int(g.max())
+        self.n_pad = pad_rows(max(n_layout, self.n),
+                              rows_per_block * row_shards)
         binned = (ds.binned if binned_override is None
                   else binned_override)   # EFB physical matrix
         if n_feature_pad and binned.shape[1] < n_feature_pad:
@@ -160,6 +179,39 @@ class _DeviceData:
             (np.arange(self.n_pad) < self.n).astype(np.float32))
 
 
+# tpu_auto_quantize only engages at the scale the A/B validated
+# (docs/perf.md): below this, exact f32 gradients are the default
+AUTO_QUANT_MIN_ROWS = 500_000
+
+
+def goss_shard_valid_counts(n_local: int, n_pad_local: int,
+                            n_global_devices: int, n_processes: int,
+                            allgather=None):
+    """Per-global-shard valid row counts for GOSS's exact subset sizes.
+
+    Single-process: this process's rows span the whole mesh, so the
+    counts fall out of the local block layout. Multi-host: each process
+    computes its LOCAL devices' counts (its chunk is placed on its own
+    addressable devices in mesh order by
+    ``make_array_from_process_local_data``) and one host-side counts
+    allgather concatenates them in process order — the same order the
+    mesh's ``axis_index`` enumerates global shards. ``allgather`` is
+    injectable for single-process tests.
+    """
+    if n_processes <= 1:
+        blk = n_pad_local // n_global_devices
+        return [max(0, min(n_local - s * blk, blk))
+                for s in range(n_global_devices)]
+    n_local_dev = max(1, n_global_devices // n_processes)
+    blk = n_pad_local // n_local_dev
+    loc = np.asarray([max(0, min(n_local - s * blk, blk))
+                      for s in range(n_local_dev)], np.int64)
+    if allgather is None:
+        from jax.experimental import multihost_utils
+        allgather = multihost_utils.process_allgather
+    return [int(v) for v in np.asarray(allgather(loc)).reshape(-1)]
+
+
 class GBDT:
     """Boosting engine (reference: GBDT class, src/boosting/gbdt.cpp)."""
 
@@ -197,6 +249,27 @@ class GBDT:
                       " use data or voting")
         self.axis = (self.mesh.axis_names[0]
                      if self.mesh is not None else "")
+        # measured-default quantized training (tpu_auto_quantize,
+        # VERDICT r4 item 2): in the A/B's validated regime — >= 500k
+        # rows, gbdt boosting, a level-sum-safe objective, no custom
+        # fobj — int8 histograms were +18-36% throughput at
+        # equal-or-better equal-round AUC (docs/perf.md). Explicit
+        # use_quantized_grad settings always win; smaller data keeps
+        # the exact-f32 default for reference bit-compatibility.
+        if (bool(config.tpu_auto_quantize)
+                and "use_quantized_grad" not in config.raw_params
+                and not config.use_quantized_grad
+                and config.boosting == "gbdt" and fobj is None
+                and self.train_set.num_data >= AUTO_QUANT_MIN_ROWS
+                and str(config.objective) in (
+                    "binary", "regression", "multiclass",
+                    "multiclassova", "cross_entropy")):
+            config.use_quantized_grad = True
+            config._quantize_auto = True
+            log.info("tpu_auto_quantize: enabling quantized gradients "
+                     "(int8 histograms) for this training — measured "
+                     "equal-AUC and faster at this scale; set "
+                     "use_quantized_grad=false to keep f32")
         self.objective: Objective = create_objective(config)
         if hasattr(self.objective, "prepare") and \
                 self.train_set.metadata.label is not None:
@@ -226,9 +299,17 @@ class GBDT:
         self.average_output = False  # RF subclass sets True
 
         n_shards = self.mesh.devices.size if self.mesh is not None else 1
+        n_rows_layout = self.train_set.num_data
+        if self.mesh is not None and jax.process_count() > 1:
+            # uneven multi-host shards: every process must derive the
+            # SAME block size or the traced SPMD programs diverge
+            from jax.experimental import multihost_utils
+            n_rows_layout = int(np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([n_rows_layout], np.int64))).max())
         rows_per_block = min(
             config.tpu_rows_per_block,
-            pad_rows(max(1, self.train_set.num_data // n_shards), 256))
+            pad_rows(max(1, n_rows_layout // n_shards), 256))
         self.rows_per_block = rows_per_block
 
         F = len(self.train_set.used_features)
@@ -435,7 +516,8 @@ class GBDT:
                                 # never pad it back to logical width
                                 n_feature_pad=(0 if self.has_bundles
                                                else self.F_pad),
-                                binned_override=self._bundled_binned)
+                                binned_override=self._bundled_binned,
+                                n_layout=n_rows_layout)
 
         self.grow_cfg = self._make_grow_cfg()
 
@@ -731,6 +813,7 @@ class GBDT:
             max_cat_to_onehot=config.max_cat_to_onehot,
             min_data_per_group=config.min_data_per_group,
             hist_scatter=_hist_scatter,
+            packed_wire=bool(config.tpu_hist_packed_wire),
             num_shards=(self.mesh.devices.size
                         if self.mesh is not None else 1),
             voting=self.learner_type == "voting",
@@ -984,17 +1067,17 @@ class GBDT:
         # only — GOSS replaces bagging), so the exact counts are
         # precomputed host-side in double and closed over as constants.
         _rows_sharded = self.mesh is not None and not self._shard_features
-        # The exact table below assumes this process sees the full row
-        # range (single host); multi-host processes only know their OWN
-        # shard sizes, so they keep the runtime (f32-floor) computation —
-        # layout-correct, at worst one row off the reference's double
-        # truncation near integer products.
-        _goss_exact = jax.process_count() == 1
+        # Exact counts at ANY process count (VERDICT r4 item 7): the
+        # per-global-shard valid row counts are assembled host-side at
+        # init — single-host directly, multi-host via one counts
+        # allgather (each process contributes its local devices' counts
+        # in mesh order, mirroring make_array_from_process_local_data's
+        # process-contiguous chunk placement) — so the double-precision
+        # truncation of goss.hpp's subset sizes holds on every shard.
         if _rows_sharded:
-            _gsh = self.mesh.devices.size
-            _blk = self.data.n_pad // _gsh
-            _local_valid = [max(0, min(self.data.n - s * _blk, _blk))
-                            for s in range(_gsh)]
+            _local_valid = goss_shard_valid_counts(
+                self.data.n, self.data.n_pad, self.mesh.devices.size,
+                jax.process_count())
         else:
             _local_valid = [self.data.n]
         goss_axis = self.axis if _rows_sharded else None
@@ -1016,15 +1099,10 @@ class GBDT:
             metric = metric * valid_mask
             n_local = metric.shape[0]
             n_valid = jnp.sum(valid_mask)
-            if _goss_exact:
-                sid = (jax.lax.axis_index(goss_axis)
-                       if goss_axis is not None else 0)
-                k_top = goss_k_top_tbl[sid]
-                k_rand = goss_k_rand_tbl[sid].astype(jnp.float32)
-            else:
-                k_top = jnp.maximum(
-                    jnp.floor(top_rate * n_valid), 1.0).astype(jnp.int32)
-                k_rand = jnp.floor(other_rate * n_valid)
+            sid = (jax.lax.axis_index(goss_axis)
+                   if goss_axis is not None else 0)
+            k_top = goss_k_top_tbl[sid]
+            k_rand = goss_k_rand_tbl[sid].astype(jnp.float32)
             k_rest = jnp.maximum(n_valid - k_top, 1.0)
             sorted_m = jnp.sort(metric)
             thresh_idx = jnp.clip(n_local - k_top, 0, n_local - 1)

@@ -80,6 +80,14 @@ class GrowConfig:
     # mesh axis for data-parallel histogram reduction ("" = single device)
     axis_name: str = ""
     # -- distributed modes (SURVEY.md §3.4) ---------------------------
+    # packed quantized collective wire (tpu_hist_packed_wire): with
+    # use_quantized_grad, each (g,h) level-sum pair rides ONE int32
+    # (g in the high 16 bits, non-negative h in the low 16) and count
+    # rides a second int32 — 2/3 of the f32 psum payload, bit-exact.
+    # A 3-scalar guard psum checks sum-of-local-extreme bounds per
+    # round; any risk of int16 overflow (or a negative hessian) falls
+    # back to the f32 reduction inside the same jitted step.
+    packed_wire: bool = False
     # data-parallel + hist_scatter: ReduceScatter feature ownership —
     # each device reduces/owns F/num_shards features, finds its local
     # best, and the winner is elected by all_gather
@@ -323,6 +331,39 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     else:
         F_s = F
 
+    # packed wire is a quantized-only, cross-device-reduce-only
+    # optimization; voting reduces elected columns later and feature-
+    # parallel/serial histograms are already complete
+    use_packed = (cfg.packed_wire and chan_scale is not None
+                  and bool(cfg.axis_name)
+                  and not (mode_voting or mode_feature))
+
+    def _reduce_op(x):
+        """The collective itself — shared by the f32 and packed paths."""
+        if mode_scatter:
+            # the reference's ReduceScatter: each device receives the
+            # summed histograms of the features it owns
+            return jax.lax.psum_scatter(x, cfg.axis_name,
+                                        scatter_dimension=1, tiled=True)
+        return jax.lax.psum(x, cfg.axis_name)
+
+    def _packed_reduce(h):
+        """(g,h) as two int16 halves of one int32 (docs/perf.md packed-
+        wire design): per-lane modular addition is carry-free because
+        the low (hessian) lane is non-negative and its GLOBAL sum stays
+        under 2^15 — guaranteed by the guard in hist_reduce. g is
+        recovered by arithmetic shift (sign-extends), h by masking."""
+        gi = h[..., 0].astype(jnp.int32)
+        hi = h[..., 1].astype(jnp.int32)
+        ci = h[..., 2].astype(jnp.int32)
+        packed = jnp.stack(
+            [(gi << 16) | (hi & 0xFFFF), ci], axis=-1)
+        packed = _reduce_op(packed)
+        g_out = (packed[..., 0] >> 16).astype(jnp.float32)
+        h_out = (packed[..., 0] & 0xFFFF).astype(jnp.float32)
+        return jnp.stack([g_out, h_out,
+                          packed[..., 1].astype(jnp.float32)], axis=-1)
+
     def hist_reduce(h):
         """Mode-specific cross-device histogram reduction. With
         quantized gradients (use_quantized_grad), ``vals`` hold small
@@ -330,16 +371,20 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         (the reference's int-histogram allreduce,
         cuda_gradient_discretizer.cu) — and are rescaled to real units
         here, right after the reduction."""
-        if mode_scatter:
-            # the reference's ReduceScatter: each device receives the
-            # summed histograms of the features it owns
-            h = jax.lax.psum_scatter(h, cfg.axis_name,
-                                     scatter_dimension=1, tiled=True)
+        if use_packed:
+            # guard: sum over devices of each device's extreme level
+            # sums bounds the global per-bin sums (|Σ_d x_d| <=
+            # Σ_d max|x_d|); 3 scalars ride one tiny psum. Negative
+            # hessians (custom objectives) also force the f32 path.
+            loc = jnp.stack([jnp.max(jnp.abs(h[..., 0])),
+                             jnp.max(h[..., 1]),
+                             jnp.maximum(-jnp.min(h[..., 1]), 0.0)])
+            glob = jax.lax.psum(loc, cfg.axis_name)
+            safe = ((glob[0] < 32767.0) & (glob[1] < 32767.0)
+                    & (glob[2] <= 0.0))
+            h = jax.lax.cond(safe, _packed_reduce, _reduce_op, h)
         elif cfg.axis_name and not (mode_voting or mode_feature):
-            h = jax.lax.psum(h, cfg.axis_name)
-        # voting reduces only elected columns later (also in quantized
-        # units — scaling is linear so rescaling here stays correct);
-        # feature-parallel/serial histograms are already complete
+            h = _reduce_op(h)
         if chan_scale is not None:
             h = h * chan_scale
         return h

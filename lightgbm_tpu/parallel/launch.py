@@ -65,11 +65,15 @@ def sync_bin_mappers(X_local: np.ndarray, params: Dict,
     """Distributed bin-boundary sync: identical BinMappers on every
     process, built from an all-gathered cross-process sample.
 
-    Each process samples up to ``bin_construct_sample_cnt /
-    process_count`` rows of its shard (deterministic seed), the
-    fixed-size padded samples ride one ``process_allgather``, and each
-    process runs the same binning code on the same union sample —
-    bit-identical mappers with no broadcast step.
+    Each process's sample quota is PROPORTIONAL to its shard's row
+    count (``bin_construct_sample_cnt * n_local / n_total``) so uneven
+    shards don't bias bin boundaries toward small shards'
+    distributions — the reference samples proportionally at the loader
+    level (``dataset_loader.cpp`` sample-indices contract, SURVEY §2.1,
+    UNVERIFIED). The fixed-size padded samples ride one
+    ``process_allgather``, and each process runs the same binning code
+    on the same union sample — bit-identical mappers with no broadcast
+    step.
     """
     import jax
     from jax.experimental import multihost_utils
@@ -79,20 +83,22 @@ def sync_bin_mappers(X_local: np.ndarray, params: Dict,
     p = params
     total_cnt = int(p.get("bin_construct_sample_cnt", 200000))
     nproc = jax.process_count()
-    per = max(1, total_cnt // max(nproc, 1))
     n_local, F = X_local.shape
     rng = np.random.default_rng(
         int(p.get("data_random_seed", 1)) + 7919 * jax.process_index())
-    k = min(per, n_local)
+    # shard row counts first: every process derives ALL ranks' sample
+    # sizes from the same gathered counts, so quotas are proportional
+    # to shard size and no second counts gather is needed
+    n_cnt = np.zeros((1,), np.int64) + n_local
+    g_n = np.asarray(multihost_utils.process_allgather(n_cnt)) \
+        .reshape(nproc).astype(np.int64)
+    n_total = max(1, int(g_n.sum()))
+    k_all = np.minimum(
+        np.maximum(1, (total_cnt * g_n) // n_total), g_n).astype(int)
+    k = int(k_all[jax.process_index()])
     idx = (rng.choice(n_local, size=k, replace=False) if k < n_local
            else np.arange(n_local))
-    # two allgathers: the tiny counts first, so the sample slot is
-    # sized by the LARGEST actual shard sample, not by the nominal
-    # bin_construct_sample_cnt (which would ship mostly-NaN padding
-    # when shards are small)
-    cnt = np.zeros((1,), np.int32) + k
-    g_cnt = np.asarray(multihost_utils.process_allgather(cnt)) \
-        .reshape(nproc)
+    g_cnt = k_all
     slot = max(1, int(g_cnt.max()))
     samp = np.full((slot, F), np.nan, np.float64)
     samp[:k] = np.asarray(X_local, np.float64)[idx]

@@ -25,6 +25,13 @@ PARAMS = {"objective": "binary", "num_leaves": 15,
           "min_data_in_leaf": 20, "verbosity": -1,
           "tree_learner": "data", "tpu_double_precision_hist": True}
 
+# GOSS variant (VERDICT r4 item 7: exact subset counts at any process
+# count) — the same SPMD program must produce identical models multi-
+# process vs single-process-fake-devices, which only holds if the
+# per-shard GOSS k_top/k_rand tables agree exactly
+GOSS_PARAMS = dict(PARAMS, data_sample_strategy="goss",
+                   top_rate=0.35, other_rate=0.25)
+
 
 def make_data():
     import numpy as np
@@ -41,6 +48,7 @@ def main():
     nproc = int(sys.argv[2])
     port = int(sys.argv[3])
     out_model = sys.argv[4]
+    use_goss = len(sys.argv) > 5 and sys.argv[5] == "goss"
 
     import jax
     jax.config.update("jax_platforms", "cpu")   # env alone is ignored
@@ -52,7 +60,7 @@ def main():
     import lightgbm_tpu as lgb
 
     X, y = make_data()
-    params = dict(PARAMS)
+    params = dict(GOSS_PARAMS if use_goss else PARAMS)
 
     if rank >= 0:
         # consistent binning across processes: every process builds the

@@ -278,3 +278,31 @@ def test_cli_save_binary(tmp_path):
                 f"output_data={out}", "verbosity=-1"]) == 0
     ds = lgb.Dataset(out)
     assert ds.num_data == 300
+
+
+def test_cli_distributed_train_uneven_shards(tmp_path):
+    """VERDICT r4 item 10: ``task=train num_machines=4`` from a config
+    file drives the fork/join launcher. Row count 4097 makes the last
+    rank's shard cross a pad-block boundary, exercising the
+    globally-agreed pad layout (shapes would diverge across processes
+    without the counts allgather)."""
+    from lightgbm_tpu.app import run
+    X, y = _data(n=4097)
+    train_path = str(tmp_path / "train.csv")
+    _write_csv(train_path, X, y)
+    model_path = str(tmp_path / "model.txt")
+    conf = tmp_path / "dist.conf"
+    conf.write_text(
+        f"task = train\n"
+        f"objective = binary\n"
+        f"data = {train_path}\n"
+        f"num_machines = 4\n"
+        f"num_iterations = 5\n"
+        f"num_leaves = 15\n"
+        f"min_data_in_leaf = 20\n"
+        f"verbosity = -1\n"
+        f"output_model = {model_path}\n")
+    assert run([f"config={conf}"]) == 0
+    assert os.path.exists(model_path)
+    bst = lgb.Booster(model_file=model_path)
+    assert np.mean((bst.predict(X) > 0.5) == y) > 0.8
