@@ -1,17 +1,95 @@
-"""Boosting engines: GBDT (base), DART, RF.
+"""Boosting engines: GBDT (base), DART, RF, streaming (out-of-core).
 
 Reference: the Boosting factory (src/boosting/boosting.cpp
 Boosting::CreateBoosting, UNVERIFIED — empty mount, see SURVEY.md banner)
 dispatches on the ``boosting`` param; ``goss`` resolves to GBDT +
-data_sample_strategy=goss at config-fixup time (config.py).
+data_sample_strategy=goss at config-fixup time (config.py). The
+streaming dispatch has no reference analog — upstream's CPU engine is
+always "out of core" relative to an accelerator; here it is the path
+that keeps >HBM datasets trainable (VERDICT r4 item 3).
 """
 from .gbdt import GBDT
 
 __all__ = ["GBDT", "create_boosting"]
 
 
+def _streaming_compatible(config) -> bool:
+    """Configs StreamingGBDT.__init__ would accept (kept in sync with
+    its _no() gates; auto mode must NEVER route a config into a
+    log.fatal that the resident engine would have trained)."""
+    return (config.tree_learner == "serial"
+            and config.boosting == "gbdt"
+            and config.num_tree_per_iteration == 1
+            and str(config.data_sample_strategy) != "goss"
+            and config.bagging_fraction >= 1.0
+            and config.bagging_freq <= 0
+            and not bool(config.linear_tree)
+            and not bool(config.monotone_constraints)
+            and not bool(config.interaction_constraints)
+            and config.cegb_penalty_split <= 0
+            and not bool(config.cegb_penalty_feature_coupled)
+            and not bool(config.cegb_penalty_feature_lazy)
+            and not bool(config.forcedsplits_filename)
+            and not bool(config.categorical_feature)
+            and str(config.objective) not in ("lambdarank",
+                                              "rank_xendcg", "custom"))
+
+
+def _should_stream(config, train_set, fobj) -> bool:
+    mode = str(getattr(config, "tpu_streaming", "auto"))
+    if mode == "false":
+        return False
+    if mode == "true":
+        return True
+    # auto: stream when the binned matrix (plus the Pallas path's
+    # feature-major int8 copy) would exceed ~60% of device HBM — the
+    # resident engine's own guard fatals at 92%, so auto-streaming
+    # kicks in with margin to spare for histograms/score/partition.
+    # Only for configs streaming supports (anything else keeps the
+    # resident engine and its own guard/sharding, e.g. a mesh run
+    # whose per-device shard fits); dataset-level gates (categorical
+    # bins) are re-checked by StreamingGBDT itself.
+    if fobj is not None or not _streaming_compatible(config):
+        return False
+    try:
+        import jax
+        if jax.device_count() > 1:
+            return False        # sharded residents divide per-device
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+    except Exception:
+        limit = None
+    if not limit:
+        return False
+    ds = train_set
+    n = getattr(ds, "num_data", None)
+    f = None
+    if getattr(ds, "_constructed", False):
+        f = len(ds.used_features)
+    elif hasattr(ds.data, "shape") and len(getattr(ds.data, "shape", ())) == 2:
+        f = int(ds.data.shape[1])
+        n = int(ds.data.shape[0])
+    if not n or not f:
+        return False
+    itemsize = 2 if int(config.max_bin) > 255 else 1
+    est = n * f * itemsize * 2        # bins + bins_t (Pallas copy)
+    if est <= 0.6 * limit:
+        return False
+    # dataset-level gate: pandas-category / auto-detected categorical
+    # bins would make StreamingGBDT fatal — keep those resident
+    ds.construct()
+    return not any(ds.bin_mappers[fi].bin_type == "categorical"
+                   for fi in ds.used_features)
+
+
 def create_boosting(config, train_set, fobj=None, mesh=None,
                     init_forest=None) -> GBDT:
+    if (str(getattr(config, "tpu_streaming", "auto")) == "true"
+            and config.boosting in ("dart", "rf")):
+        from ..utils import log
+        log.fatal(f"tpu_streaming=true supports boosting=gbdt only "
+                  f"(got {config.boosting}); DART/RF need the resident "
+                  f"engine")
     if config.boosting == "dart":
         from .dart import DART
         return DART(config, train_set, fobj=fobj, mesh=mesh,
@@ -20,5 +98,9 @@ def create_boosting(config, train_set, fobj=None, mesh=None,
         from .rf import RandomForest
         return RandomForest(config, train_set, fobj=fobj, mesh=mesh,
                             init_forest=init_forest)
+    if _should_stream(config, train_set, fobj):
+        from .streaming import StreamingGBDT
+        return StreamingGBDT(config, train_set, fobj=fobj, mesh=mesh,
+                             init_forest=init_forest)
     return GBDT(config, train_set, fobj=fobj, mesh=mesh,
                 init_forest=init_forest)

@@ -624,11 +624,17 @@ class Dataset:
                 log.fatal(f"pushed chunk has {chunk.shape[1]} features, "
                           f"reference has {ref.num_total_features}")
             dtype = ref.binned.dtype
-            cols = [ref.bin_mappers[f].values_to_bins(chunk[:, f])
-                    .astype(dtype) for f in ref.used_features]
-            self._pushed.append(
-                np.stack(cols, axis=1) if cols
-                else np.zeros((len(chunk), 0), dtype))
+            if ref.used_features:
+                # native one-pass binning (same hot path construct and
+                # predict use) — the per-column Python fallback is
+                # ~200x slower, which matters exactly here: push_rows
+                # is the >HBM streaming ingest path
+                self._pushed.append(
+                    ref._bin_all_columns(chunk, False, dtype,
+                                         n_rows=len(chunk)))
+            else:
+                self._pushed.append(
+                    np.zeros((len(chunk), 0), dtype))
         else:
             self._pushed.append(chunk)
         if label is not None:

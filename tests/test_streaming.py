@@ -1,0 +1,115 @@
+"""Out-of-core streaming engine (boosting/streaming.py, VERDICT r4
+item 3): host-resident bins, level-wise streamed growth.
+
+The reference trains any dataset that fits host RAM
+(``dataset_loader.cpp`` two-round + row-wise bin storage, SURVEY §2.1,
+UNVERIFIED); the streaming engine is this framework's equivalent for
+data whose binned matrix exceeds HBM.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=20_000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 16, "max_depth": 4,
+        "verbosity": -1, "min_data_in_leaf": 20}
+
+
+def test_streaming_block_count_invariant():
+    """Training must be BIT-identical no matter how the rows are cut
+    into streamed blocks — the accumulated histograms are exact sums."""
+    X, y = _data()
+    texts = []
+    for blk in (30_000, 2_048):
+        bst = lgb.train(dict(BASE, tpu_streaming="true",
+                             tpu_stream_block_rows=blk),
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+        texts.append(bst.model_to_string())
+    assert texts[0] == texts[1]
+
+
+def test_streaming_close_to_resident():
+    """At a complete depth (num_leaves = 2^max_depth) level-wise and
+    best-first growth choose from the same split sets; models may
+    differ on float near-ties but quality must match the resident
+    engine."""
+    X, y = _data(seed=3)
+    accs = {}
+    for mode in ("true", "false"):
+        bst = lgb.train(dict(BASE, tpu_streaming=mode),
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+        pred = bst.predict(X)
+        accs[mode] = np.mean((pred > 0.5) == y)
+        assert np.isfinite(pred).all()
+    assert abs(accs["true"] - accs["false"]) < 0.01
+
+
+def test_streaming_model_roundtrip_and_missing(tmp_path):
+    """NaN routing (default_left) + v4 text round-trip from the
+    streaming engine."""
+    X, y = _data(seed=5)
+    X[::7, 0] = np.nan          # informative missingness on the main
+    y[::7] = 1.0                 # feature
+    bst = lgb.train(dict(BASE, tpu_streaming="true"),
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    p = bst.predict(X)
+    mf = tmp_path / "m.txt"
+    bst.save_model(str(mf))
+    p2 = lgb.Booster(model_file=str(mf)).predict(X)
+    np.testing.assert_allclose(p, p2, rtol=1e-6, atol=1e-9)
+    assert np.mean((p > 0.5) == y) > 0.8
+
+
+def test_streaming_regression_weighted():
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(8_000, 6))
+    y = X[:, 0] * 2 + X[:, 1] ** 2 + rng.normal(scale=0.1, size=8_000)
+    w = rng.uniform(0.5, 2.0, size=8_000)
+    bst = lgb.train(dict(BASE, objective="regression",
+                         tpu_streaming="true",
+                         tpu_stream_block_rows=2_048),
+                    lgb.Dataset(X, label=y, weight=w),
+                    num_boost_round=20)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < np.var(y) * 0.3
+
+
+def test_streaming_feature_fraction_and_l1():
+    X, y = _data(seed=11)
+    bst = lgb.train(dict(BASE, tpu_streaming="true",
+                         feature_fraction=0.6, lambda_l1=0.5,
+                         lambda_l2=2.0),
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    assert np.mean((bst.predict(X) > 0.5) == y) > 0.8
+
+
+def test_streaming_rejects_unsupported():
+    X, y = _data(n=2_000)
+    from lightgbm_tpu.utils.log import LightGBMError
+    for extra in ({"data_sample_strategy": "goss"},
+                  {"num_class": 3, "objective": "multiclass"},
+                  {"linear_tree": True},
+                  {"boosting": "dart"}):
+        with pytest.raises(LightGBMError):
+            lgb.train(dict(BASE, tpu_streaming="true", **extra),
+                      lgb.Dataset(X, label=y.astype(float)),
+                      num_boost_round=2)
+
+
+def test_streaming_sklearn_surface():
+    """The sklearn wrapper composes with the streaming engine (predict
+    goes through the host model path)."""
+    X, y = _data(seed=13)
+    clf = lgb.LGBMClassifier(n_estimators=8, num_leaves=16, max_depth=4,
+                             verbosity=-1, tpu_streaming="true")
+    clf.fit(X, y)
+    assert (clf.predict(X) == y).mean() > 0.8
