@@ -283,6 +283,11 @@ class StreamingGBDT:
                 jnp.asarray(self._pad_block(self.weight, lo, hi))
                 if self.weight is not None else ones_w)
         self._zeros_leaf = zeros_leaf
+        # the f32 copies were only needed for the device upload; at
+        # 1e9+ rows they are multiple GiB of host RAM. (The Dataset's
+        # own float64 metadata.label stays — it backs the public
+        # get_label() API and is owned by the Dataset, not the engine.)
+        self.label = self.weight = None
         log.info(
             f"streaming engine: {self.n} rows x {F} features binned on "
             f"host ({self.binned.nbytes / 2**30:.2f} GiB), "
@@ -406,6 +411,7 @@ class StreamingGBDT:
                 frontier + [-1] * (K_pad - K), np.int32))
             tbl_dev = {k: jnp.asarray(v) for k, v in table.items()}
             hist = None
+            prev = None          # (bins_blk, hist-after-that-block)
             for b, lo, hi in self._blocks():
                 bins_blk = jnp.asarray(self._pad_block(self.binned, lo, hi))
                 leaf_new, h_blk = self._sweep(
@@ -414,6 +420,21 @@ class StreamingGBDT:
                     self._leaf_dev[b], tbl_dev, frontier_dev)
                 self._leaf_dev[b] = leaf_new    # stays on device
                 hist = h_blk if hist is None else hist + h_blk
+                # throttle + free with a 2-block in-flight window:
+                # unthrottled async dispatch would enqueue EVERY
+                # block's ~256 MB device buffer before the device
+                # drains one — at 128 blocks that is ~34 GB of live
+                # transients and an OOM (observed at the 32 GiB proof
+                # shape). Blocking on the PREVIOUS block keeps upload
+                # of block b+1 overlapped with compute of block b
+                # while bounding transients to ~512 MB.
+                if prev is not None:
+                    jax.block_until_ready(prev[1])
+                    prev[0].delete()
+                prev = (bins_blk, hist)
+            if prev is not None:
+                jax.block_until_ready(prev[1])
+                prev[0].delete()
             # leaf totals straight from the histogram: any one
             # feature's bins partition the leaf's rows
             parent_sums = jnp.sum(hist[:, 0, :, :], axis=1)
@@ -490,13 +511,21 @@ class StreamingGBDT:
                                              leaf_sums[lf][1])
         tbl_dev = {k: jnp.asarray(v) for k, v in table.items()}
         leaf_out_dev = jnp.asarray(leaf_out)
+        prev = None
         for b, lo, hi in self._blocks():
+            bins_blk = jnp.asarray(self._pad_block(self.binned, lo, hi))
             leaf_new, score_new = self._final(
-                jnp.asarray(self._pad_block(self.binned, lo, hi)),
-                self._score_dev[b], self._leaf_dev[b],
+                bins_blk, self._score_dev[b], self._leaf_dev[b],
                 tbl_dev, leaf_out_dev)
             self._leaf_dev[b] = leaf_new
             self._score_dev[b] = score_new
+            if prev is not None:
+                jax.block_until_ready(prev[1])
+                prev[0].delete()
+            prev = (bins_blk, score_new)
+        if prev is not None:
+            jax.block_until_ready(prev[1])
+            prev[0].delete()
 
         tree_arrays = {
             "num_leaves": nl,

@@ -677,6 +677,9 @@ class Dataset:
         if self._pushed_meta["weight"]:
             self.metadata.weight = np.concatenate(
                 self._pushed_meta["weight"])
+        # free the metadata chunk lists in BOTH branches (at 1e9+
+        # streamed rows the retained label chunks alone are ~10 GB)
+        self._pushed_meta = {"label": [], "weight": []}
         if self.reference is not None:
             ref = self.reference.construct()
             self.binned = np.concatenate(self._pushed, axis=0)
