@@ -2055,6 +2055,7 @@ class GBDT:
 
         Returns list of (data_name, metric_name, value, higher_better).
         """
+        from ..metric import eval_metric_rows
         if which < 0:
             dd, name = self.data, "training"
             raw = np.asarray(self.score)[:dd.n]
@@ -2062,16 +2063,12 @@ class GBDT:
             dd = self.valid_data[which]
             name = self.valid_names[which]
             raw = np.asarray(self.valid_scores[which])[:dd.n]
-        pred = self._convert_output_np(raw)
-        out = []
         label = np.asarray(dd.label)[:dd.n] if dd.label is not None else None
         weight = (np.asarray(dd.weight)[:dd.n]
                   if dd.weight is not None else None)
-        for m in self.metrics:
-            for mname, value in m.eval(pred, label, weight,
-                                       dd.query_boundaries):
-                out.append((name, mname, value, m.higher_better))
-        return out
+        return eval_metric_rows(self.objective, self.metrics, name,
+                                raw, label, weight,
+                                dd.query_boundaries, self.num_class)
 
     def _convert_output_np(self, raw: np.ndarray) -> np.ndarray:
         if self.num_class == 1:

@@ -195,6 +195,7 @@ class StreamingGBDT:
         self.iter_ = 0
         self.valid_data: list = []
         self.valid_names: list = []
+        self._valid_raw_cache: Dict[int, tuple] = {}
         self.fobj = None
         self.metrics = metrics_for_config(config)
 
@@ -341,10 +342,65 @@ class StreamingGBDT:
         return self.iter_
 
     def add_valid(self, data, name):
-        log.fatal(self._UNSUPPORTED_MSG.format(what="valid sets"))
+        """Valid sets evaluate via the host model over the RAW valid
+        features (the streaming engine never bins or uploads them —
+        a valid set large enough to matter should be subsampled)."""
+        raw = getattr(data, "data", None)
+        if raw is None or isinstance(raw, str):
+            log.fatal(self._UNSUPPORTED_MSG.format(
+                what="valid sets without in-memory raw features "
+                     "(file-backed, or already constructed with the "
+                     "raw matrix freed — pass a fresh Dataset)"))
+        self.valid_data.append(data)
+        self.valid_names.append(name)
+
+    @property
+    def valid_scores(self):
+        log.fatal(self._UNSUPPORTED_MSG.format(
+            what="custom feval over valid sets"))
 
     def eval_set(self, which: int):
-        return []
+        """(data_name, metric_name, value, higher_better) tuples —
+        the resident engine's contract (GBDT.eval_set), via the shared
+        metric helper so the two engines cannot drift.
+
+        Training eval (which=-1) pulls the full device-resident score
+        each call — 4 bytes/row of D2H; at 1e9-row scale through a
+        slow pull path enable it sparingly (metric_freq)."""
+        from ..metric import eval_metric_rows
+        if which < 0:
+            name = "training"
+            raw = np.concatenate(
+                [np.asarray(self._score_dev[b])[:hi - lo]
+                 for b, lo, hi in self._blocks()])
+            md = self.train_set.metadata
+            label, weight, qb = md.label, md.weight, md.query_boundaries
+        else:
+            ds = self.valid_data[which]
+            name = self.valid_names[which]
+            # incremental raw cache: only the NEW trees since the last
+            # eval traverse the valid matrix (the host model folds the
+            # init score into tree 0, so increments sum exactly);
+            # without this, per-iteration eval would rebuild and
+            # re-traverse the whole forest — O(T^2) over training
+            done, raw = self._valid_raw_cache.get(
+                which, (0, np.zeros(len(ds.data), np.float64)))
+            n_now = len(self.models)
+            if n_now > done:
+                raw = raw + self.predict(
+                    ds.data, raw_score=True, start_iteration=done,
+                    num_iteration=n_now - done)
+                self._valid_raw_cache[which] = (n_now, raw)
+            if ds.metadata.init_score is not None:
+                # per-row valid init score (resident engine adds it in
+                # _init_score_tile; the host model knows nothing of it)
+                raw = raw + np.asarray(ds.metadata.init_score,
+                                       np.float64)
+            label = ds.metadata.label
+            weight = ds.metadata.weight
+            qb = ds.metadata.query_boundaries
+        return eval_metric_rows(self.objective, self.metrics, name,
+                                raw, label, weight, qb, 1)
 
     def rollback_one_iter(self):
         log.fatal(self._UNSUPPORTED_MSG.format(what="rollback"))

@@ -395,3 +395,25 @@ def metrics_for_config(config) -> List[Metric]:
         if m is not None:
             out.append(m)
     return out
+
+
+def eval_metric_rows(objective, metrics, name, raw, label, weight,
+                     query_boundaries, num_class: int):
+    """Shared eval helper: convert a raw-score matrix/vector through
+    the objective and run every metric, returning the engine.eval_set
+    contract — ``(data_name, metric_name, value, higher_better)``
+    tuples. Both boosting engines (resident GBDT and streaming) call
+    this so their eval semantics cannot drift."""
+    import jax.numpy as jnp
+    raw = np.asarray(raw, np.float64)
+    if num_class == 1 and raw.ndim == 2:
+        raw = raw[:, 0]
+    pred = np.asarray(objective.convert_output(jnp.asarray(raw)))
+    label = None if label is None else np.asarray(label)
+    weight = None if weight is None else np.asarray(weight)
+    out = []
+    for m in metrics:
+        for mname, value in m.eval(pred, label, weight,
+                                   query_boundaries):
+            out.append((name, mname, value, m.higher_better))
+    return out

@@ -113,3 +113,23 @@ def test_streaming_sklearn_surface():
                              verbosity=-1, tpu_streaming="true")
     clf.fit(X, y)
     assert (clf.predict(X) == y).mean() > 0.8
+
+
+def test_streaming_valid_eval_and_early_stopping():
+    """Valid-set metrics + early-stopping callbacks compose with the
+    streaming engine (valid sets evaluate via the host model over raw
+    features; training metric reads the device-resident score)."""
+    X, y = _data(n=30_000, seed=21)
+    ds = lgb.Dataset(X[:24_000], label=y[:24_000])
+    vs = ds.create_valid(X[24_000:], label=y[24_000:])
+    evals = {}
+    bst = lgb.train(dict(BASE, metric="auc", tpu_streaming="true",
+                         is_provide_training_metric=True),
+                    ds, num_boost_round=10,
+                    valid_sets=[vs], valid_names=["val"],
+                    callbacks=[lgb.record_evaluation(evals),
+                               lgb.early_stopping(5, verbose=False)])
+    aucs = evals["val"]["auc"]
+    assert len(aucs) == 10 and aucs[-1] > aucs[0] > 0.5
+    assert "training" in evals           # device-score train metric
+    assert bst.best_iteration >= 1
