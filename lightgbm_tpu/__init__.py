@@ -8,8 +8,8 @@ leaf-id partitioning, and mesh collectives (psum/psum_scatter/all_gather)
 in place of the reference's socket/MPI/NCCL distributed learners.
 """
 from .basic import Booster, Dataset, LightGBMError
-from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       record_evaluation, reset_parameter)
+from .callback import (EarlyStopException, checkpoint, early_stopping,
+                       log_evaluation, record_evaluation, reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
 
@@ -19,7 +19,8 @@ __all__ = [
     "Booster", "Dataset", "LightGBMError", "Config",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "reset_parameter", "EarlyStopException", "checkpoint",
+    "CheckpointManager", "CheckpointError",
 ]
 
 
@@ -42,6 +43,9 @@ def __getattr__(name):
                     "sync_bin_mappers"):
             from .parallel import launch as _la
             return getattr(_la, name)
+        if name in ("CheckpointManager", "CheckpointError"):
+            from .recovery import checkpoint as _ck
+            return getattr(_ck, name)
     except ImportError as e:
         raise AttributeError(
             f"module 'lightgbm_tpu' has no attribute {name!r}: {e}") from e

@@ -52,6 +52,27 @@ class DART(GBDT):
         return False
 
     # ------------------------------------------------------------------
+    def export_train_state(self):
+        st = super().export_train_state()
+        st["dart"] = {
+            "rng_drop": self._rng_drop.get_state(),
+            "iter_weights": [float(w) for w in self._iter_weights],
+            "sum_weight": float(self._sum_weight),
+        }
+        return st
+
+    def import_train_state(self, state) -> bool:
+        restored = super().import_train_state(state)
+        d = state.get("dart")
+        if d is not None:
+            # replaces __init__'s lossy lr-per-iteration seeding with
+            # the exact per-iteration weights the run had accumulated
+            self._rng_drop.set_state(d["rng_drop"])
+            self._iter_weights = [float(w) for w in d["iter_weights"]]
+            self._sum_weight = float(d["sum_weight"])
+        return restored
+
+    # ------------------------------------------------------------------
     def _select_drop(self) -> np.ndarray:
         """DART::DroppingTrees — iteration indices to drop this round."""
         c = self.config

@@ -1962,6 +1962,134 @@ class GBDT:
         return jnp.asarray(out)
 
     # ------------------------------------------------------------------
+    # fault-tolerant training state (recovery subsystem). The model
+    # trees travel separately as model text; this is everything ELSE
+    # that evolves across iterations and that init_model continuation
+    # loses: host RNG streams, the exact score arrays, the current
+    # bagging mask, CEGB acquisition state, position-bias state.
+    def _rows_to_host(self, arr) -> Optional[np.ndarray]:
+        """Host copy of a per-row device array: the process-LOCAL row
+        chunk under a multi-process mesh (each process checkpoints its
+        own shard), the full array otherwise."""
+        if arr is None:
+            return None
+        if self.mesh is not None and jax.process_count() > 1:
+            shards = {(s.index[0].start or 0): s
+                      for s in arr.addressable_shards}
+            return np.concatenate(
+                [np.asarray(shards[k].data) for k in sorted(shards)],
+                axis=0)
+        return np.asarray(arr)
+
+    def export_train_state(self) -> Dict[str, Any]:
+        """Complete training state for a durable checkpoint (the model
+        itself is serialized separately as model text)."""
+        return {
+            "engine": type(self).__name__,
+            "iteration": int(self.iter_),
+            # the engine's host trees travel as exact pickled copies
+            # (model TEXT rounds internal_value/leaf_weight through
+            # "{:g}", which would break bit-exact DART drop traversal)
+            "models": list(self.models),
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+            "init_scores": self.init_scores.copy(),
+            "rng_feature": self._rng_feature.get_state(),
+            "rng_bagging": self._rng_bagging.get_state(),
+            "bag_mask": self._rows_to_host(self._bag_mask),
+            "score": self._rows_to_host(self.score),
+            "valid_scores": [self._rows_to_host(s)
+                             for s in self.valid_scores],
+            "cegb_used": (None if self._cegb_used is None
+                          else np.asarray(self._cegb_used).copy()),
+            "cegb_U": (None if self._cegb_U is None
+                       else np.asarray(self._cegb_U)),
+            "pos_state": (None if self._pos_state is None
+                          else jax.tree.map(np.asarray, self._pos_state)),
+        }
+
+    def import_train_state(self, state: Dict[str, Any]) -> bool:
+        """Restore :meth:`export_train_state` output into a freshly
+        constructed engine (no init_forest — the checkpoint's pickled
+        trees are adopted directly). Returns True when the exact score
+        arrays were restored (bit-exact resume); False when they were
+        rebuilt from the restored forest (topology/shape mismatch —
+        training stays correct but is no longer bit-exact vs an
+        uninterrupted run)."""
+        saved_engine = state.get("engine")
+        if saved_engine is not None \
+                and saved_engine != type(self).__name__:
+            log.fatal(
+                f"checkpoint was written by a {saved_engine} engine but "
+                f"resume constructed {type(self).__name__} — the "
+                f"boosting/tree_learner params must match the original "
+                f"run")
+        models = state.get("models")
+        if models is None:
+            log.fatal("checkpoint state holds no model trees — corrupt "
+                      "or incompatible checkpoint")
+        self.models = list(models)
+        self.iter_ = len(self.models) // self.num_class
+        if int(state["iteration"]) != self.iter_:
+            log.fatal(
+                f"checkpoint state is for iteration "
+                f"{state['iteration']} but holds "
+                f"{self.iter_} iterations of trees — mismatched "
+                f"checkpoint contents")
+        self._rng_feature.set_state(state["rng_feature"])
+        self._rng_bagging.set_state(state["rng_bagging"])
+        if state.get("init_scores") is not None:
+            # the checkpoint's model text is UNBIASED (no AddBias fold);
+            # the bias lives here and is re-folded at the next save
+            self.init_scores = np.asarray(state["init_scores"],
+                                          dtype=np.float64)
+        same_topo = (
+            int(state.get("process_count", 1)) == jax.process_count()
+            and int(state.get("process_index", 0)) == jax.process_index())
+        cur = self._rows_to_host(self.score)
+        sc = state.get("score")
+        saved_valid = state.get("valid_scores") or []
+        # valid sets are guarded like the train score: a changed valid
+        # set (count or padded shape) must not silently adopt the old
+        # set's accumulated predictions into this run's eval state
+        valid_ok = (len(saved_valid) == len(self.valid_scores)
+                    and all(v is not None and v.shape
+                            == self._rows_to_host(
+                                self.valid_scores[i]).shape
+                            for i, v in enumerate(saved_valid)))
+        restored = bool(same_topo and sc is not None
+                        and sc.shape == cur.shape and valid_ok)
+        if restored:
+            self.score = self.data._place(sc, extra_dims=2)
+            bm = state.get("bag_mask")
+            self._bag_mask = (None if bm is None
+                              else self.data._place(bm))
+            for i, vs in enumerate(saved_valid):
+                self.valid_scores[i] = self.valid_data[i]._place(
+                    vs, extra_dims=2)
+        else:
+            log.warning(
+                "checkpoint scores were saved under a different process "
+                "topology, data shape, or valid-set layout; rebuilding "
+                "scores from the restored model (training continues "
+                "correctly but is not bit-exact vs an uninterrupted "
+                "run)")
+            # rebuild with the RESTORED init_scores (the checkpoint's
+            # model text carries no bias of its own)
+            self._recompute_scores()
+        if state.get("cegb_used") is not None \
+                and self._cegb_used is not None:
+            self._cegb_used[:] = state["cegb_used"]
+            self._cegb_pen_cache = None
+        if state.get("cegb_U") is not None and self._cegb_lazy is not None:
+            self._cegb_U = jnp.asarray(state["cegb_U"])
+        if state.get("pos_state") is not None \
+                and self._pos_state is not None:
+            self._pos_state = jax.tree.map(jnp.asarray,
+                                           state["pos_state"])
+        return restored
+
+    # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter — drop the last iteration's trees."""
         if self.iter_ == 0:

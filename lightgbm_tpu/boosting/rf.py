@@ -114,6 +114,30 @@ class RandomForest(GBDT):
                                          + self._valid_pred_sum[vi] / n)
 
     # ------------------------------------------------------------------
+    def export_train_state(self):
+        st = super().export_train_state()
+        st["rf"] = {
+            "pred_sum": self._rows_to_host(self._pred_sum),
+            "valid_pred_sum": [self._rows_to_host(s)
+                               for s in self._valid_pred_sum],
+        }
+        return st
+
+    def import_train_state(self, state) -> bool:
+        restored = super().import_train_state(state)
+        rf = state.get("rf")
+        if restored and rf is not None and rf["pred_sum"] is not None:
+            # the averaged display score was restored by the base; the
+            # running biased-prediction sums are RF's true accumulators
+            self._pred_sum = self.data._place(rf["pred_sum"],
+                                              extra_dims=2)
+            for i, vs in enumerate(rf.get("valid_pred_sum") or []):
+                if i < len(self.valid_data) and vs is not None:
+                    self._valid_pred_sum[i] = self.valid_data[i]._place(
+                        vs, extra_dims=2)
+        return restored
+
+    # ------------------------------------------------------------------
     def _recompute_scores(self) -> None:
         super()._recompute_scores()
         n = self.iter_

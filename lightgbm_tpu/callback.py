@@ -77,8 +77,14 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     best_iter: List[int] = []
     best_score_list: List[Any] = []
     cmp_op: List[Callable] = []
+    higher_better_list: List[bool] = []
     enabled = [True]
     first_metric = [""]
+
+    def _make_cmp(higher_better: bool) -> Callable:
+        if higher_better:
+            return lambda x, y: x > y + min_delta
+        return lambda x, y: x < y - min_delta
 
     def _init(env: CallbackEnv) -> None:
         enabled[0] = bool(env.evaluation_result_list)
@@ -93,15 +99,26 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         for *_head, higher_better in env.evaluation_result_list:
             best_iter.append(0)
             best_score_list.append(None)
-            if higher_better:
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda x, y: x > y + min_delta)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda x, y: x < y - min_delta)
+            higher_better_list.append(bool(higher_better))
+            cmp_op.append(_make_cmp(higher_better))
+            best_score.append(float("-inf") if higher_better
+                              else float("inf"))
 
     def _callback(env: CallbackEnv) -> None:
         if not best_score:
+            _init(env)
+        elif enabled[0] and env.evaluation_result_list \
+                and len(best_score) != len(env.evaluation_result_list):
+            # restored checkpoint state from a run with a different
+            # metric/valid-set layout: reinitialize rather than index
+            # stale lists (best-effort resume, like the score rebuild)
+            log.warning(
+                "early-stopping state restored from the checkpoint "
+                "does not match this run's metric/valid-set layout; "
+                "reinitializing early-stopping tracking")
+            for lst in (best_score, best_iter, best_score_list, cmp_op,
+                        higher_better_list):
+                lst.clear()
             _init(env)
         if not enabled[0]:
             return
@@ -126,5 +143,103 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                     log.info(f"Did not meet early stopping. Best iteration "
                              f"is:\n[{best_iter[i] + 1}]")
                 raise EarlyStopException(best_iter[i], best_score_list[i])
+
+    # checkpoint/resume hooks (recovery subsystem): the best-score
+    # tracking above is closure state, so a resumed run must restore it
+    # explicitly for bit-exact stopping decisions. cmp_op holds lambdas
+    # (not picklable) and is rebuilt from the saved direction flags.
+    def _get_state() -> Dict[str, Any]:
+        return {
+            "best_score": list(best_score),
+            "best_iter": list(best_iter),
+            "best_score_list": [None if s is None
+                                else [tuple(r) for r in s]
+                                for s in best_score_list],
+            "higher_better": list(higher_better_list),
+            "enabled": enabled[0],
+            "first_metric": first_metric[0],
+        }
+
+    def _set_state(state: Dict[str, Any]) -> None:
+        best_score[:] = [float(v) for v in state["best_score"]]
+        best_iter[:] = [int(v) for v in state["best_iter"]]
+        best_score_list[:] = [None if s is None
+                              else [tuple(r) for r in s]
+                              for s in state["best_score_list"]]
+        higher_better_list[:] = [bool(b) for b in state["higher_better"]]
+        cmp_op[:] = [_make_cmp(b) for b in higher_better_list]
+        enabled[0] = bool(state["enabled"])
+        first_metric[0] = state["first_metric"]
     _callback.order = 30
+    _callback.state_key = "early_stopping"
+    _callback.get_state = _get_state
+    _callback.set_state = _set_state
+    return _callback
+
+
+def checkpoint(checkpoint_dir: str, interval: int = 1, keep_n: int = 3,
+               manager=None) -> Callable:
+    """Durable-checkpoint callback: every ``interval`` iterations,
+    atomically persist COMPLETE training state — model text, iteration
+    counter, bagging/feature/DART host RNG states, the exact score
+    arrays, early-stopping best-score state — so
+    ``lgb.train(..., resume_from=checkpoint_dir)`` continues bit-exact
+    (stronger than ``init_model``, which drops RNG/best-score state).
+
+    ``engine.train`` wires this automatically from the
+    ``checkpoint_dir`` / ``checkpoint_interval`` params; pass it in
+    ``callbacks=[...]`` for manual control (e.g. a shared
+    ``CheckpointManager``). See docs/robustness.md.
+    """
+    from .recovery.checkpoint import CheckpointManager
+    mgr = (manager if manager is not None
+           else CheckpointManager(checkpoint_dir, keep_n=keep_n))
+    peers: List[Callable] = []
+    warned = [False]
+
+    def _callback(env: CallbackEnv) -> None:
+        it = env.iteration + 1
+        if interval <= 0 or it % int(interval) != 0:
+            return
+        model = env.model
+        engine = getattr(model, "_engine", None)
+        if engine is None or not hasattr(engine, "export_train_state"):
+            if not warned[0]:
+                warned[0] = True
+                log.warning(
+                    "callback.checkpoint: the model has no resident "
+                    "GBDT engine (cv boosters and the streaming engine "
+                    "are not checkpointable); skipping checkpoint saves")
+            return
+        cb_states: Dict[str, Any] = {}
+        for cb in peers:
+            key = getattr(cb, "state_key", None)
+            if key and hasattr(cb, "get_state"):
+                cb_states[key] = cb.get_state()
+        # model_str is a NORMAL self-contained model save (salvageable
+        # with Booster(model_str=...) for ops); resume restores the
+        # engine's host trees from the exact pickled copies in the
+        # engine state instead — model text rounds internal_value/
+        # leaf_weight through "{:g}", which is not bit-exact
+        state = {
+            "version": 1,
+            "iteration": it,
+            "model_str": model.model_to_string(),
+            "engine": engine.export_train_state(),
+            "callbacks": cb_states,
+            "booster": {
+                "best_iteration": model.best_iteration,
+                "best_score": {k: dict(v)
+                               for k, v in model.best_score.items()},
+            },
+        }
+        mgr.save(state, it)
+
+    def _bind(callbacks: List[Callable]) -> None:
+        peers[:] = [cb for cb in callbacks if cb is not _callback]
+    # after early_stopping (order 30) so the saved best-score state
+    # reflects this iteration's evaluation
+    _callback.order = 40
+    _callback.bind_callbacks = _bind
+    _callback.checkpoint_manager = mgr
     return _callback

@@ -240,6 +240,26 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
                          "name_pred"]),
     "snapshot_freq": _P("int", -1, ["save_period"]),
     "saved_feature_importance_type": _P("int", 0),
+    # ---- Fault tolerance (recovery subsystem; docs/robustness.md) --------
+    # directory for durable training checkpoints (atomic tmp+rename
+    # writes, sha256-verified, bounded retention); resume with
+    # lgb.train(..., resume_from=<dir>). Unlike snapshot_freq (model
+    # text only), checkpoints persist the COMPLETE training state —
+    # RNG streams, exact scores, early-stopping best-score state — so
+    # an interrupted-then-resumed run is bit-exact.
+    "checkpoint_dir": _P("str", ""),
+    # iterations between checkpoints (0 = checkpointing off)
+    "checkpoint_interval": _P("int", 0, ["checkpoint_freq"], (0, None)),
+    # newest checkpoints kept per rank; older ones are pruned
+    "checkpoint_keep": _P("int", 3, [], (1, None)),
+    # fault injection for fault-tolerance CI: "kill:rank=1,iter=10"
+    # SIGKILLs rank 1 before iteration 10; "exn:iter=5" raises. Fires
+    # once per (spec, rank) when a marker dir is available (see
+    # tpu_fault_marker). Empty = off.
+    "tpu_fault_inject": _P("str", ""),
+    # marker directory for fault fire-once bookkeeping (defaults to
+    # checkpoint_dir when unset)
+    "tpu_fault_marker": _P("str", ""),
     # ---- TPU-specific (new; no reference analog) -------------------------
     "tpu_rows_per_block": _P("int", 4096),
     "tpu_mesh_shape": _P("str", ""),

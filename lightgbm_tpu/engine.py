@@ -37,8 +37,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
           init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
-          fobj: Optional[Callable] = None) -> Booster:
-    """Train a model (mirrors lightgbm.train)."""
+          fobj: Optional[Callable] = None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Train a model (mirrors lightgbm.train).
+
+    ``resume_from``: a checkpoint directory (or file) written by the
+    ``checkpoint_dir``/``checkpoint_interval`` params or
+    ``callback.checkpoint``. Restores the COMPLETE training state —
+    model, RNG streams, exact scores, early-stopping state — and runs
+    the REMAINING iterations up to ``num_boost_round`` (a total-round
+    target, unlike ``init_model`` which always adds ``num_boost_round``
+    more). An interrupted-then-resumed run is bit-exact vs an
+    uninterrupted one (docs/robustness.md). A directory with no valid
+    checkpoint yet starts fresh — so restart loops can pass it
+    unconditionally.
+    """
     params = copy.deepcopy(params)
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
     cfg = Config(params)
@@ -47,10 +60,27 @@ def train(params: Dict[str, Any], train_set: Dataset,
         params["objective"] = "custom"
         cfg = Config(params)
 
+    resume_state = None
+    if resume_from is not None:
+        from .recovery.checkpoint import load_for_resume
+        resume_state = load_for_resume(resume_from)
+        if resume_state is None:
+            log.info(f"resume_from={str(resume_from)!r}: no valid "
+                     f"checkpoint yet; starting fresh")
+        elif init_model is not None:
+            log.warning("resume_from and init_model were both given; "
+                        "resume_from wins (the checkpoint carries its "
+                        "own model)")
+            init_model = None
+
     # training continuation (gbdt.cpp: load existing models, rebuild
-    # scores, keep boosting): accept a file path, Booster, or HostModel
+    # scores, keep boosting): accept a file path, Booster, or HostModel.
+    # A checkpoint resume does NOT go through init_forest: the engine is
+    # constructed fresh (identical to the original run's construction)
+    # and import_train_state adopts the checkpoint's exact pickled
+    # trees + scores + RNG streams afterwards.
     init_forest = None
-    if init_model is not None:
+    if init_model is not None and resume_state is None:
         import os
         if isinstance(init_model, Booster):
             init_forest = (init_model._from_model
@@ -81,6 +111,58 @@ def train(params: Dict[str, Any], train_set: Dataset,
             cfg.early_stopping_round, cfg.first_metric_only,
             verbose=cfg.verbosity >= 1,
             min_delta=cfg.early_stopping_min_delta))
+    if cfg.checkpoint_dir and cfg.checkpoint_interval > 0:
+        ckpt_cb = callback_mod.checkpoint(
+            cfg.checkpoint_dir, interval=cfg.checkpoint_interval,
+            keep_n=cfg.checkpoint_keep)
+        if resume_state is None:
+            # fresh run claiming this directory: stale checkpoints from
+            # a previous run would otherwise be adopted by a later
+            # restart/resume and silently continue the OLD run
+            cleared = ckpt_cb.checkpoint_manager.clear_rank_files()
+            if cleared:
+                log.warning(
+                    f"checkpoint_dir {cfg.checkpoint_dir} held "
+                    f"{cleared} checkpoint(s) from a previous run; "
+                    f"cleared for this fresh run")
+        callbacks.append(ckpt_cb)
+    if str(cfg.tpu_fault_inject).strip():
+        from .recovery.faults import fault_injection_callback
+        callbacks.append(fault_injection_callback(
+            cfg.tpu_fault_inject,
+            marker_dir=(cfg.tpu_fault_marker or cfg.checkpoint_dir)))
+
+    start_iter = 0
+    if resume_state is not None:
+        eng = booster.engine
+        if not hasattr(eng, "import_train_state"):
+            log.fatal("resume_from requires the resident GBDT engine "
+                      "(the streaming engine does not checkpoint); set "
+                      "tpu_streaming=false or drop resume_from")
+        eng.import_train_state(resume_state["engine"])
+        bstate = resume_state.get("booster") or {}
+        booster.best_iteration = int(bstate.get("best_iteration", -1))
+        booster.best_score = {k: dict(v) for k, v in
+                              (bstate.get("best_score") or {}).items()}
+        cb_states = resume_state.get("callbacks") or {}
+        for cb in callbacks:
+            key = getattr(cb, "state_key", None)
+            if key and key in cb_states and hasattr(cb, "set_state"):
+                cb.set_state(cb_states[key])
+        start_iter = eng.iter_
+        log.info(f"resumed training from checkpoint "
+                 f"{resume_state.get('_checkpoint_path', '?')} at "
+                 f"iteration {start_iter}")
+        if start_iter >= num_boost_round:
+            log.warning(f"checkpoint is already at iteration "
+                        f"{start_iter} >= num_boost_round "
+                        f"{num_boost_round}; nothing left to train")
+    # hand the checkpoint callback the full callback list so it can
+    # snapshot peers' state (early stopping) into each checkpoint
+    for cb in callbacks:
+        if hasattr(cb, "bind_callbacks"):
+            cb.bind_callbacks(callbacks)
+
     callbacks_before = [cb for cb in callbacks
                         if getattr(cb, "before_iteration", False)]
     callbacks_after = [cb for cb in callbacks
@@ -107,12 +189,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 and cfg.tpu_fuse_iters > 1 and cfg.snapshot_freq <= 0
                 and booster.engine.can_fuse_iters()):
             with timed("boosting (fused chunks)"):
-                booster.engine.train_chunk(num_boost_round)
+                booster.engine.train_chunk(num_boost_round - start_iter)
             booster.best_iteration = booster.current_iteration()
             log_timers()
             return booster
 
-        for it in range(num_boost_round):
+        for it in range(start_iter, num_boost_round):
             env_pre = callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=it,
                 begin_iteration=0, end_iteration=num_boost_round,

@@ -33,7 +33,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import socket
 from dataclasses import dataclass
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Union
 
 import numpy as np
 
@@ -117,13 +117,18 @@ def run_worker(params: Dict, data_fn: Callable[[int, int], ShardSpec],
                num_processes: Optional[int] = None,
                coordinator: Optional[str] = None,
                platform: Optional[str] = None,
-               categorical_feature="auto"):
+               categorical_feature="auto",
+               resume_from: Optional[str] = None):
     """The per-process worker body (call once per host on a pod).
 
     Joins the ``jax.distributed`` job, fetches this process's shard
     from ``data_fn(rank, num_processes)``, syncs bin boundaries across
     all processes, trains the data-parallel learner, and returns the
     Booster (identical on every rank — the SPMD program IS the sync).
+
+    ``resume_from``: checkpoint directory to resume from (every rank
+    restores its OWN per-rank checkpoint; ranks agree on the resume
+    iteration via an allgather — recovery/checkpoint.py).
     """
     import jax
     if platform:
@@ -154,11 +159,12 @@ def run_worker(params: Dict, data_fn: Callable[[int, int], ShardSpec],
     cat_idx = ds._resolve_categorical(
         ds._resolve_feature_names(shard.data.shape[1]))
     ds.bin_mappers = sync_bin_mappers(shard.data, params, cat_idx)
-    return lgb.train(params, ds, num_boost_round=num_boost_round)
+    return lgb.train(params, ds, num_boost_round=num_boost_round,
+                     resume_from=resume_from)
 
 
 def _spawn_main(rank, nproc, port, params, data_fn, num_boost_round,
-                platform, categorical_feature, queue):
+                platform, categorical_feature, queue, resume_from):
     try:
         # children inherit the parent's env; a fake-device-count flag
         # (e.g. the test suite's 8-device CPU mesh) would multiply the
@@ -173,7 +179,8 @@ def _spawn_main(rank, nproc, port, params, data_fn, num_boost_round,
                          num_processes=nproc,
                          coordinator=f"localhost:{port}",
                          platform=platform,
-                         categorical_feature=categorical_feature)
+                         categorical_feature=categorical_feature,
+                         resume_from=resume_from)
         if rank == 0:
             queue.put(("ok", bst.model_to_string()))
     except Exception as e:          # surface the real worker error
@@ -183,12 +190,95 @@ def _spawn_main(rank, nproc, port, params, data_fn, num_boost_round,
         raise
 
 
+def _gang_once(params: Dict, data_fn, n_processes: int,
+               num_boost_round: int, platform, categorical_feature,
+               timeout: float, resume_from: Optional[str]):
+    """One fork/join pass over a fresh worker gang on a fresh port.
+    Returns the ("ok", model_str) / ("err", payload) queue result, or
+    None when the gang died or timed out without reporting (plus the
+    dead rank/exitcode list for the error message)."""
+    ctx = mp.get_context("spawn")     # fork would inherit JAX state
+    port = _free_port()
+    queue = ctx.Queue()
+    procs = [ctx.Process(
+        target=_spawn_main,
+        args=(r, n_processes, port, params, data_fn, num_boost_round,
+              platform, categorical_feature, queue, resume_from))
+        for r in range(n_processes)]
+    for p in procs:
+        p.start()
+    # poll: fail FAST when a worker dies before rank 0 reports (e.g. a
+    # non-importable data_fn under spawn, or an injected worker kill)
+    # instead of sitting out the full timeout — the dask.py analog of
+    # surfacing worker loss
+    import queue as _queue
+    import time as _time
+    result = None
+    deadline = _time.monotonic() + timeout
+    while result is None and _time.monotonic() < deadline:
+        try:
+            result = queue.get(timeout=2.0)
+        except _queue.Empty:
+            dead = [(i, p.exitcode) for i, p in enumerate(procs)
+                    if not p.is_alive() and p.exitcode not in (0, None)]
+            if dead:
+                break
+        except Exception as e:
+            # a worker killed MID-put leaves a truncated pickle in the
+            # queue pipe; that is a gang failure to recover from — it
+            # must reach the teardown + restart loop below, not escape
+            # as a raw unpickling traceback that leaks hung workers
+            result = ("err", f"worker result was undeliverable "
+                      f"({type(e).__name__}: {e}) — a worker likely "
+                      f"died while reporting")
+            break
+    # tear the gang down. On a clean result the workers exit on their
+    # own (grant a grace join); on a dead/failed gang the survivors are
+    # stuck in collectives waiting for the lost rank and will NEVER
+    # exit, so don't sit out per-process joins — escalate to terminate
+    # -> kill immediately (restart latency is the backoff, not this)
+    clean = result is not None and result[0] == "ok"
+    grace = 10.0 if clean else 0.5
+    deadline = _time.monotonic() + grace
+    for p in procs:
+        p.join(timeout=max(0.0, deadline - _time.monotonic()))
+        if p.is_alive():
+            p.terminate()
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.kill()
+            p.join(timeout=5)
+    if result is None:
+        # a dying worker may have flushed its ('err', traceback) into
+        # the queue between our last poll and the liveness check —
+        # prefer that real error over the generic message. Only an
+        # EMPTY queue is expected here; a real unpickling error must
+        # surface, not vanish into a generic timeout message.
+        try:
+            result = queue.get_nowait()
+        except _queue.Empty:
+            pass
+        except Exception as e:
+            result = ("err", f"worker result was undeliverable "
+                      f"({type(e).__name__}: {e}) — a worker likely "
+                      f"died while reporting")
+    dead = [(i, p.exitcode) for i, p in enumerate(procs)
+            if p.exitcode not in (0, None)]
+    return result, dead
+
+
 def train_distributed(params: Dict,
                       data_fn: Callable[[int, int], ShardSpec],
                       n_processes: int, num_boost_round: int = 100, *,
                       platform: Optional[str] = "cpu",
                       categorical_feature="auto",
-                      timeout: float = 900.0):
+                      timeout: float = 900.0,
+                      max_restarts: int = 0,
+                      restart_backoff: float = 1.0,
+                      checkpoint_dir: Optional[str] = None,
+                      checkpoint_interval: int = 0,
+                      resume: Union[bool, str] = "auto"):
     """Train over ``n_processes`` localhost processes and return the
     rank-0 Booster (the dask.py ``_train`` analog).
 
@@ -203,60 +293,116 @@ def train_distributed(params: Dict,
       platform: force a JAX platform in the workers ("cpu" default —
         this environment exposes one TPU chip, which cannot be shared
         by N processes; pass None on a real pod).
-      timeout: seconds to wait for the workers.
+      timeout: seconds to wait for the workers (per attempt).
+      max_restarts: automatic gang restarts after a worker death or
+        timeout. Each restart terminates the gang, waits an
+        exponential backoff, and relaunches every rank on a FRESH
+        coordinator port; with a checkpoint dir holding a valid rank-0
+        checkpoint the gang resumes from it, otherwise it restarts the
+        run from scratch. 0 preserves the old fail-fast behavior.
+      restart_backoff: base seconds for the exponential restart
+        backoff (doubles per attempt, capped at 30 s).
+      checkpoint_dir / checkpoint_interval: convenience for setting the
+        same-named params on every worker (periodic durable per-rank
+        checkpoints; docs/robustness.md). ``checkpoint_dir`` in
+        ``params`` works identically.
+      resume: "auto" (default) resumes from the newest valid rank-0
+        checkpoint in the checkpoint dir when one exists — so re-running
+        the SAME call after a whole-driver crash/preemption continues
+        the job instead of wiping its checkpoints. False forces a fresh
+        run (stale checkpoints are cleared); True requires a resumable
+        checkpoint and raises when the dir holds none.
     """
-    ctx = mp.get_context("spawn")     # fork would inherit JAX state
-    port = _free_port()
-    queue = ctx.Queue()
-    procs = [ctx.Process(
-        target=_spawn_main,
-        args=(r, n_processes, port, params, data_fn, num_boost_round,
-              platform, categorical_feature, queue))
-        for r in range(n_processes)]
-    for p in procs:
-        p.start()
-    # poll: fail FAST when a worker dies before rank 0 reports (e.g. a
-    # non-importable data_fn under spawn) instead of sitting out the
-    # full timeout — the dask.py analog of surfacing worker loss
-    import queue as _queue
-    import time as _time
-    result = None
-    deadline = _time.monotonic() + timeout
-    while result is None and _time.monotonic() < deadline:
-        try:
-            result = queue.get(timeout=2.0)
-        except _queue.Empty:
-            dead = [(i, p.exitcode) for i, p in enumerate(procs)
-                    if not p.is_alive() and p.exitcode not in (0, None)]
-            if dead:
-                break
-    for p in procs:
-        p.join(timeout=30)
-        if p.is_alive():
-            p.terminate()
-    if result is None:
-        # a dying worker may have flushed its ('err', traceback) into
-        # the queue between our last poll and the liveness check —
-        # prefer that real error over the generic message
-        try:
-            result = queue.get_nowait()
-        except Exception:
-            pass
-    if result is None:
-        dead = [(i, p.exitcode) for i, p in enumerate(procs)
-                if p.exitcode not in (0, None)]
+    from ..recovery.restart import (backoff_seconds,
+                                    has_resumable_checkpoint,
+                                    is_bind_failure)
+    params = dict(params)
+    if checkpoint_dir:
+        params["checkpoint_dir"] = str(checkpoint_dir)
+    if checkpoint_interval > 0:
+        # independent of HOW checkpoint_dir was supplied (kwarg or
+        # params) — the dir may come from params with the cadence here
+        params["checkpoint_interval"] = int(checkpoint_interval)
+    ckpt_dir = str(params.get("checkpoint_dir") or "") or None
+
+    # cross-driver resume: a preempted/killed DRIVER re-running the
+    # same call must continue the job, not clear its checkpoints
+    resume_from = None
+    if resume not in (False, True, "auto"):
+        raise LightGBMError(f"resume must be True, False or 'auto', "
+                            f"got {resume!r}")
+    if resume in (True, "auto") and ckpt_dir \
+            and has_resumable_checkpoint(ckpt_dir):
+        resume_from = ckpt_dir
+        log.info(f"resuming distributed training from the newest "
+                 f"checkpoint in {ckpt_dir}")
+    if resume is True and resume_from is None:
         raise LightGBMError(
-            "distributed training produced no result "
-            + (f"(worker ranks/exitcodes {dead} died — is data_fn a "
-               f"module-level importable callable? spawn re-imports "
-               f"its module in each worker)" if dead else
-               "(workers timed out before rank 0 reported; re-run "
-               "with verbosity>=1 for worker logs)"))
-    status, payload = result
-    if status != "ok":
-        raise LightGBMError(f"distributed worker failed: {payload}")
+            f"resume=True but {ckpt_dir!r} holds no valid rank-0 "
+            f"checkpoint to resume from")
+    if resume is False and ckpt_dir:
+        # clear driver-side BEFORE the first launch: if the gang died
+        # before any worker reached its own fresh-run clearing, the
+        # restart path's has_resumable_checkpoint would adopt the old
+        # run the caller explicitly asked to discard
+        from ..recovery.checkpoint import clear_checkpoint_dir
+        cleared = clear_checkpoint_dir(ckpt_dir)
+        if cleared:
+            log.warning(f"resume=False: cleared {cleared} stale "
+                        f"checkpoint(s) from {ckpt_dir}")
+
+    attempt = 0           # restart attempts consumed (not bind retries)
+    while True:
+        result = None
+        # the coordinator port race (_free_port -> jax.distributed
+        # bind) loses when another process grabs the probed port first;
+        # a bind failure retries on a fresh port WITHOUT consuming a
+        # restart attempt
+        for bind_attempt in range(3):
+            result, dead = _gang_once(
+                params, data_fn, n_processes, num_boost_round, platform,
+                categorical_feature, timeout, resume_from)
+            if (result is not None and result[0] == "err"
+                    and is_bind_failure(result[1]) and bind_attempt < 2):
+                log.warning(
+                    "coordinator port was reclaimed before bind "
+                    "(the _free_port race); relaunching the worker "
+                    "gang on a fresh port")
+                continue
+            break
+        if result is not None and result[0] == "ok":
+            bst_str = result[1]
+            break
+        if result is not None:
+            failure = LightGBMError(
+                f"distributed worker failed: {result[1]}")
+        else:
+            failure = LightGBMError(
+                "distributed training produced no result "
+                + (f"(worker ranks/exitcodes {dead} died — is data_fn "
+                   f"a module-level importable callable? spawn "
+                   f"re-imports its module in each worker)" if dead else
+                   "(workers timed out before rank 0 reported; re-run "
+                   "with verbosity>=1 for worker logs)"))
+        attempt += 1
+        if attempt > max_restarts:
+            raise failure
+        resume_from = (ckpt_dir if ckpt_dir
+                       and has_resumable_checkpoint(ckpt_dir) else None)
+        delay = backoff_seconds(attempt, restart_backoff)
+        log.warning(
+            f"distributed training attempt {attempt} of "
+            f"{max_restarts + 1} failed ({failure}); "
+            + (f"resuming every rank from the newest checkpoint in "
+               f"{resume_from} " if resume_from else
+               "no resumable checkpoint — restarting from scratch ")
+            + f"on a fresh port after {delay:.1f}s backoff")
+        import time as _time
+        _time.sleep(delay)
+
     import lightgbm_tpu as lgb
-    bst = lgb.Booster(model_str=payload)
+    bst = lgb.Booster(model_str=bst_str)
     log.info(f"distributed training done: {n_processes} processes, "
-             f"{bst.num_trees()} trees collected from rank 0")
+             f"{bst.num_trees()} trees collected from rank 0"
+             + (f" ({attempt} restart(s))" if attempt else ""))
     return bst

@@ -36,9 +36,19 @@ from ..utils import log
 from ..utils.log import LightGBMError
 
 
+# substrings (lowercased) that identify a TRANSIENT coordinator error
+# worth retrying: the coordinator process is still coming up, or the
+# connection dropped. "Already initialized" / misuse errors are not
+# transient and raise immediately.
+_TRANSIENT_TOKENS = ("timeout", "timed out", "deadline", "unavailable",
+                     "connection", "refused", "temporarily", "reset")
+
+
 def init_multihost(coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
-                   process_id: Optional[int] = None) -> None:
+                   process_id: Optional[int] = None, *,
+                   connect_retries: int = 2,
+                   retry_backoff: float = 1.0) -> None:
     """Join the multi-host training job (call once per host process,
     before ANY other JAX use).
 
@@ -46,7 +56,17 @@ def init_multihost(coordinator_address: Optional[str] = None,
     ``machine_list_file`` rank discovery: on TPU pods call with no
     arguments (auto-discovery); elsewhere pass the coordinator's
     ``ip:port``, the world size, and this process's rank.
+
+    Transient coordinator-connect failures (the coordinator not up
+    yet, dropped connections) retry up to ``connect_retries`` times
+    with exponential backoff before raising; non-transient errors
+    (double initialization, JAX already used) raise immediately. Every
+    failure mode — including timeout/connection errors that are not
+    ``RuntimeError`` — surfaces as the same actionable
+    ``LightGBMError``.
     """
+    import time
+
     import jax
     kwargs = {}
     if coordinator_address is not None:
@@ -55,15 +75,36 @@ def init_multihost(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    try:
-        jax.distributed.initialize(**kwargs)
-    except RuntimeError as e:
-        raise LightGBMError(
-            f"jax.distributed.initialize failed: {e}. Common causes: "
-            f"JAX was already used in this process (init_multihost must "
-            f"be the first JAX call), initialize() was called twice, or "
-            f"the coordinator at {coordinator_address!r} is "
-            f"unreachable.") from e
+    for conn_attempt in range(connect_retries + 1):
+        try:
+            jax.distributed.initialize(**kwargs)
+            break
+        except (RuntimeError, TimeoutError, ConnectionError, OSError) as e:
+            transient = any(tok in str(e).lower()
+                            for tok in _TRANSIENT_TOKENS)
+            if transient and conn_attempt < connect_retries:
+                # a failed initialize leaves jax's distributed global
+                # state partially set (client assigned before connect),
+                # and a second initialize() would fail with the
+                # non-transient "called once" error — reset it first
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                from ..recovery.restart import backoff_seconds
+                delay = backoff_seconds(conn_attempt + 1, retry_backoff)
+                log.warning(
+                    f"coordinator connect attempt {conn_attempt + 1} of "
+                    f"{connect_retries + 1} failed ({e}); retrying in "
+                    f"{delay:.1f}s")
+                time.sleep(delay)
+                continue
+            raise LightGBMError(
+                f"jax.distributed.initialize failed: {e}. Common causes: "
+                f"JAX was already used in this process (init_multihost "
+                f"must be the first JAX call), initialize() was called "
+                f"twice, or the coordinator at {coordinator_address!r} "
+                f"is unreachable.") from e
     log.info(f"multi-host initialized: process {jax.process_index()} of "
              f"{jax.process_count()}, {jax.device_count()} global / "
              f"{jax.local_device_count()} local devices")

@@ -1,0 +1,22 @@
+"""Fault-tolerant training: durable checkpoints, gang restart, fault
+injection.
+
+The production training-stack answer to worker death / OOM / preemption
+(the reference's socket-collective reconnect story, SURVEY.md
+§distributed — UNVERIFIED): periodically persist *complete* training
+state to disk (``checkpoint``), restart the worker gang from the newest
+valid checkpoint on failure (``restart``, wired into
+``parallel.launch.train_distributed``), and exercise the whole loop in
+CI by killing live workers mid-training (``faults``). See
+docs/robustness.md for the file format, atomicity guarantees, and
+restart semantics.
+"""
+from .checkpoint import CheckpointError, CheckpointManager, load_for_resume
+from .faults import FaultPlan, fault_injection_callback, parse_fault_spec
+from .restart import backoff_seconds, has_resumable_checkpoint, is_bind_failure
+
+__all__ = [
+    "CheckpointError", "CheckpointManager", "load_for_resume",
+    "FaultPlan", "fault_injection_callback", "parse_fault_spec",
+    "backoff_seconds", "has_resumable_checkpoint", "is_bind_failure",
+]

@@ -1,0 +1,371 @@
+"""Durable training checkpoints: atomic writes, checksums, bounded
+retention, and resume-time loading with corruption fallback.
+
+File format (version 1, binary; see docs/robustness.md):
+
+    LGBMTPU-CKPT-v1\\n
+    sha256:<hex digest of the payload>\\n
+    bytes:<payload byte count>\\n
+    <pickled payload>
+
+The payload is a pickled dict holding the complete training state —
+model text, iteration counter, host RNG states (bagging / feature
+fraction / DART drop), the exact device score arrays, early-stopping
+best-score state — assembled by ``callback.checkpoint``.
+
+Atomicity: the blob is written to a temp file in the same directory,
+fsync'd, then ``os.replace``d into place, so a reader never observes a
+half-written checkpoint under POSIX rename semantics. A kill mid-write
+leaves at worst a stale ``.tmp.*`` file and the previous checkpoints
+intact; a checkpoint truncated by any other means fails the length or
+sha256 check at load time and the loader falls back to the previous
+valid one.
+
+Multi-process layout: every process writes its OWN per-rank file
+(``ckpt_<iter>.rank<r>.ckpt``) because the exact score arrays are
+row-shards local to each process; the rank-0 file is the source of
+truth for restart decisions. A per-rank ``latest.rank<r>`` pointer file
+names the newest checkpoint for quick lookup (the scan-based fallback
+wins when the pointer is stale or its target is corrupt).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import re
+import tempfile
+from typing import Any, Dict, List, Optional, Union
+
+from ..utils import log
+from ..utils.log import LightGBMError
+
+MAGIC = b"LGBMTPU-CKPT-v1"
+CHECKPOINT_VERSION = 1
+_FILE_RE = re.compile(r"^ckpt_(\d{8})\.rank(\d+)\.ckpt$")
+
+__all__ = ["CheckpointError", "CheckpointManager", "load_for_resume",
+           "MAGIC", "CHECKPOINT_VERSION"]
+
+
+class CheckpointError(LightGBMError):
+    """A checkpoint file is missing, truncated, or corrupt."""
+
+
+def _default_rank() -> int:
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class CheckpointManager:
+    """Owns one checkpoint directory for one process rank."""
+
+    def __init__(self, directory: Union[str, os.PathLike], keep_n: int = 3,
+                 rank: Optional[int] = None):
+        self.dir = str(directory)
+        self.keep_n = max(1, int(keep_n))
+        self.rank = _default_rank() if rank is None else int(rank)
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- naming ---------------------------------------------------------
+    def filename(self, iteration: int, rank: Optional[int] = None) -> str:
+        r = self.rank if rank is None else int(rank)
+        return f"ckpt_{int(iteration):08d}.rank{r}.ckpt"
+
+    def path(self, iteration: int, rank: Optional[int] = None) -> str:
+        return os.path.join(self.dir, self.filename(iteration, rank))
+
+    @property
+    def latest_pointer(self) -> str:
+        return os.path.join(self.dir, f"latest.rank{self.rank}")
+
+    # -- write ----------------------------------------------------------
+    def save(self, state: Dict[str, Any], iteration: int) -> str:
+        """Atomically persist ``state`` as this rank's checkpoint for
+        ``iteration``; updates the ``latest`` pointer and prunes old
+        checkpoints beyond ``keep_n``."""
+        state = dict(state)
+        state.setdefault("version", CHECKPOINT_VERSION)
+        state.setdefault("iteration", int(iteration))
+        payload = pickle.dumps(state, protocol=4)
+        digest = hashlib.sha256(payload).hexdigest()
+        blob = b"\n".join([
+            MAGIC,
+            b"sha256:" + digest.encode("ascii"),
+            b"bytes:" + str(len(payload)).encode("ascii"),
+            payload,
+        ])
+        final = self.path(iteration)
+        self._atomic_write(final, blob)
+        self._atomic_write(self.latest_pointer,
+                           self.filename(iteration).encode("ascii") + b"\n")
+        self._prune(current=int(iteration))
+        log.debug(f"checkpoint saved: {final} "
+                  f"({len(payload)} bytes, sha256 {digest[:12]}…)")
+        return final
+
+    def _atomic_write(self, final: str, blob: bytes) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".tmp.",
+                                   suffix=".part")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _prune(self, current: int) -> None:
+        """Keep the newest ``keep_n`` iterations up to ``current``.
+        Iterations ABOVE the one just saved can only be leftovers from
+        a previous run in a reused directory — delete them too (they
+        would otherwise win every resume/restart and silently continue
+        the OLD run), and never let them push the just-written
+        checkpoint out of the retention window."""
+        its = self.iterations()
+        stale = [it for it in its if it > current]
+        if stale:
+            log.warning(
+                f"checkpoint dir {self.dir} held higher-iteration "
+                f"checkpoints {stale} from a previous run; removing "
+                f"them (rank {self.rank})")
+        live = [it for it in its if it <= current]
+        for it in stale + live[:-self.keep_n]:
+            try:
+                os.unlink(self.path(it))
+            except OSError:
+                pass
+
+    def clear_rank_files(self) -> int:
+        """Delete THIS rank's checkpoint files and latest pointer (a
+        fresh, non-resuming run claiming a reused directory — stale
+        checkpoints would otherwise be picked up by a later restart).
+        Fault fire-once markers are left alone. Returns the count of
+        removed checkpoints."""
+        its = self.iterations()
+        for it in its:
+            try:
+                os.unlink(self.path(it))
+            except OSError:
+                pass
+        try:
+            os.unlink(self.latest_pointer)
+        except OSError:
+            pass
+        return len(its)
+
+    # -- read -----------------------------------------------------------
+    def iterations(self) -> List[int]:
+        """Iterations with a checkpoint file for THIS rank, ascending
+        (no validity check — see :meth:`latest_valid_iteration`)."""
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        for name in names:
+            m = _FILE_RE.match(name)
+            if m and int(m.group(2)) == self.rank:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def load_file(self, path: str,
+                  verify_only: bool = False) -> Optional[Dict[str, Any]]:
+        """Read + verify one checkpoint file (magic, length, sha256,
+        version); raises :class:`CheckpointError` on any mismatch.
+        ``verify_only`` skips the (potentially large) unpickle and
+        returns None — checkpoints carry full score arrays, so validity
+        scans must not deserialize every candidate."""
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise CheckpointError(f"{path}: cannot read checkpoint: {e}")
+        parts = blob.split(b"\n", 3)
+        if len(parts) != 4 or parts[0] != MAGIC:
+            raise CheckpointError(
+                f"{path}: not a lightgbm-tpu checkpoint (bad magic)")
+        digest = parts[1].partition(b":")[2].decode("ascii", "replace")
+        try:
+            nbytes = int(parts[2].partition(b":")[2])
+        except ValueError:
+            raise CheckpointError(f"{path}: corrupt header")
+        payload = parts[3]
+        if len(payload) != nbytes:
+            raise CheckpointError(
+                f"{path}: truncated (expected {nbytes} payload bytes, "
+                f"found {len(payload)})")
+        if hashlib.sha256(payload).hexdigest() != digest:
+            raise CheckpointError(
+                f"{path}: checksum mismatch (truncated or corrupt write)")
+        if verify_only:
+            return None
+        try:
+            state = pickle.loads(payload)
+        except Exception as e:
+            raise CheckpointError(f"{path}: cannot unpickle payload: {e}")
+        if not isinstance(state, dict) \
+                or int(state.get("version", -1)) != CHECKPOINT_VERSION:
+            ver = state.get("version") if isinstance(state, dict) else "?"
+            raise CheckpointError(
+                f"{path}: unsupported checkpoint version {ver!r} "
+                f"(this build reads version {CHECKPOINT_VERSION})")
+        state["_checkpoint_path"] = path
+        return state
+
+    def load(self, iteration: Optional[int] = None) -> Dict[str, Any]:
+        """Load a checkpoint for this rank. With ``iteration``: that
+        exact one (no fallback). Without: the ``latest`` pointer first,
+        then newest-to-oldest scan, skipping corrupt files with a
+        warning."""
+        if iteration is not None:
+            return self.load_file(self.path(iteration))
+        tried: List[str] = []
+        try:
+            with open(self.latest_pointer) as f:
+                name = f.read().strip()
+            if name and os.sep not in name and _FILE_RE.match(name):
+                p = os.path.join(self.dir, name)
+                tried.append(p)
+                return self.load_file(p)
+        except OSError:
+            pass
+        except CheckpointError as e:
+            log.warning(f"checkpoint 'latest' pointer target is invalid "
+                        f"({e}); scanning {self.dir} for the newest "
+                        f"valid checkpoint")
+        for it in reversed(self.iterations()):
+            p = self.path(it)
+            if p in tried:
+                continue
+            try:
+                return self.load_file(p)
+            except CheckpointError as e:
+                log.warning(f"skipping invalid checkpoint: {e}; falling "
+                            f"back to the previous one")
+        raise CheckpointError(
+            f"no valid checkpoint for rank {self.rank} in {self.dir}")
+
+    def latest_valid_iteration(self) -> Optional[int]:
+        """Newest iteration whose checkpoint verifies (checksum only —
+        no unpickle), or None."""
+        for it in reversed(self.iterations()):
+            try:
+                self.load_file(self.path(it), verify_only=True)
+                return it
+            except CheckpointError:
+                continue
+        return None
+
+
+def clear_checkpoint_dir(directory: Union[str, os.PathLike]) -> int:
+    """Remove EVERY rank's checkpoint files and latest pointers from
+    ``directory`` (driver-side fresh-run hygiene — worker-side clearing
+    can be skipped when a gang dies before reaching it, and a later
+    restart would then adopt the stale run). Fault fire-once markers
+    are left alone. Returns the count of removed checkpoints."""
+    directory = str(directory)
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    for name in names:
+        if _FILE_RE.match(name) or name.startswith("latest.rank"):
+            try:
+                os.unlink(os.path.join(directory, name))
+                removed += bool(_FILE_RE.match(name))
+            except OSError:
+                pass
+    return removed
+
+
+def load_for_resume(path: Union[str, os.PathLike],
+                    keep_n: int = 3) -> Optional[Dict[str, Any]]:
+    """Resolve ``lgb.train(resume_from=...)``: a checkpoint FILE loads
+    directly (raising on corruption — the user named it explicitly); a
+    DIRECTORY loads the newest valid checkpoint for this process's
+    rank, or None when the directory holds no valid checkpoint yet
+    (fresh start).
+
+    Multi-process: ranks agree on one iteration by all-gathering each
+    rank's newest valid iteration and resuming from the MINIMUM, so a
+    rank whose newest write was interrupted cannot desync the gang. If
+    any rank has no valid checkpoint, every rank starts fresh together.
+    """
+    path = str(path)
+    if os.path.isfile(path):
+        mgr = CheckpointManager(os.path.dirname(path) or ".",
+                                keep_n=keep_n)
+        return mgr.load_file(path)
+    if not os.path.isdir(path) and (
+            _FILE_RE.match(os.path.basename(path))
+            or path.endswith(".ckpt")):
+        # a nonexistent path that LOOKS like a checkpoint file is a
+        # typo the user must hear about — silently training from
+        # scratch (and creating a junk directory named like a file)
+        # would discard the run they asked to continue. A nonexistent
+        # DIRECTORY stays a valid fresh start (and must still join the
+        # multi-rank agreement gather below).
+        raise CheckpointError(f"{path}: checkpoint file does not exist")
+    mgr = CheckpointManager(path, keep_n=keep_n)
+    try:
+        import jax
+        nproc = int(jax.process_count())
+    except Exception:
+        nproc = 1
+    if nproc > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        def _gather(value: int) -> "np.ndarray":
+            mine = np.asarray([value], np.int64)
+            return np.asarray(
+                multihost_utils.process_allgather(mine)).reshape(-1)
+
+        latest = mgr.latest_valid_iteration()
+        gathered = _gather(latest if latest is not None else -1)
+        target = int(gathered.min())
+        if target < 0:
+            if latest is not None:
+                log.warning(
+                    "resume: some ranks have no valid checkpoint in "
+                    f"{path}; all ranks restart from scratch to stay "
+                    f"consistent")
+            return None
+        if latest is not None and target != latest:
+            log.warning(f"resume: ranks disagree on the newest valid "
+                        f"checkpoint ({sorted(set(gathered.tolist()))}); "
+                        f"resuming all ranks from iteration {target}")
+        # two-phase agreement: a rank may have already PRUNED (or hold
+        # a corrupt copy of) the agreed older iteration; loading must
+        # succeed on EVERY rank or no rank may resume, else the gang
+        # desyncs (and a crash here would repeat on every restart)
+        try:
+            state = mgr.load(iteration=target)
+            ok = 1
+        except CheckpointError as e:
+            log.warning(f"resume: cannot load the gang-agreed "
+                        f"checkpoint iteration {target} ({e})")
+            state, ok = None, 0
+        if int(_gather(ok).min()) == 0:
+            log.warning(
+                "resume: not every rank could load the agreed "
+                f"checkpoint iteration {target}; all ranks restart "
+                f"from scratch to stay consistent")
+            return None
+        return state
+    # single process: one pass — newest valid checkpoint with
+    # corruption fallback, None when the directory holds nothing valid
+    try:
+        return mgr.load()
+    except CheckpointError:
+        return None

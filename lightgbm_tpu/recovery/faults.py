@@ -1,0 +1,119 @@
+"""Fault injection for fault-tolerance CI (``tpu_fault_inject``).
+
+Spec syntax: ``"<kind>:key=value,key=value"`` with kind one of
+
+* ``kill`` — SIGKILL this process (simulates preemption / OOM-kill),
+* ``exn``  — raise ``LightGBMError`` (simulates an in-band failure).
+
+Keys: ``iter`` (required; 0-based boosting iteration — the fault fires
+BEFORE that iteration runs) and ``rank`` (optional ``jax`` process
+index; default: every process). Examples: ``"kill:rank=1,iter=10"``,
+``"exn:iter=5"``.
+
+Fire-once semantics: when a marker directory is available (explicit
+``tpu_fault_marker``, else ``checkpoint_dir``), firing writes a marker
+file keyed by (spec, rank); a restarted process that replays the same
+iteration skips the fault instead of dying forever in a restart loop.
+Without a marker directory the fault fires on every matching pass —
+fine for single-shot tests, wrong for restart loops (documented in
+docs/robustness.md).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import signal
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..utils import log
+from ..utils.log import LightGBMError
+
+__all__ = ["FaultPlan", "parse_fault_spec", "fault_injection_callback"]
+
+
+def _current_rank() -> int:
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+@dataclass
+class FaultPlan:
+    kind: str                   # "kill" | "exn"
+    iteration: int              # fires before this 0-based iteration
+    rank: Optional[int]         # None = every process
+    marker_dir: str             # "" = no fire-once marker
+    spec: str                   # original spec text (for messages)
+
+    def marker_path(self, rank: int) -> str:
+        h = hashlib.sha1(self.spec.encode("utf-8")).hexdigest()[:10]
+        return os.path.join(self.marker_dir,
+                            f".fault_fired.{h}.rank{rank}")
+
+    def maybe_fire(self, iteration: int) -> None:
+        """Fire the fault if ``iteration`` matches and it has not fired
+        before (per the marker file). ``kill`` does not return."""
+        if int(iteration) != self.iteration:
+            return
+        rank = _current_rank()
+        if self.rank is not None and rank != self.rank:
+            return
+        if self.marker_dir:
+            mp = self.marker_path(rank)
+            if os.path.exists(mp):
+                log.debug(f"tpu_fault_inject: {self.spec!r} already "
+                          f"fired (marker {mp}); skipping")
+                return
+            os.makedirs(self.marker_dir, exist_ok=True)
+            with open(mp, "w") as f:
+                f.write(self.spec + "\n")
+        if self.kind == "kill":
+            log.warning(f"tpu_fault_inject: killing process (rank "
+                        f"{rank}) before iteration {self.iteration} "
+                        f"({self.spec!r})")
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise LightGBMError(
+            f"tpu_fault_inject: injected failure before iteration "
+            f"{self.iteration} ({self.spec!r})")
+
+
+def parse_fault_spec(spec: str, marker_dir: str = "") -> FaultPlan:
+    s = str(spec).strip()
+    kind, _, rest = s.partition(":")
+    kind = kind.strip().lower()
+    if kind not in ("kill", "exn"):
+        log.fatal(f"tpu_fault_inject: unknown fault kind {kind!r} in "
+                  f"{spec!r} (expected 'kill:...' or 'exn:...')")
+    fields = {}
+    for tok in rest.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        k, _, v = tok.partition("=")
+        k, v = k.strip(), v.strip()
+        if k not in ("iter", "rank") or not v.lstrip("-").isdigit():
+            log.fatal(f"tpu_fault_inject: cannot parse {tok!r} in "
+                      f"{spec!r} (expected iter=<n> and optional "
+                      f"rank=<n>)")
+        fields[k] = int(v)
+    if "iter" not in fields:
+        log.fatal(f"tpu_fault_inject: {spec!r} needs an iter=<n> field")
+    return FaultPlan(kind=kind, iteration=fields["iter"],
+                     rank=fields.get("rank"),
+                     marker_dir=str(marker_dir or ""), spec=s)
+
+
+def fault_injection_callback(spec: str, marker_dir: str = "") -> Callable:
+    """Before-iteration training callback wrapping a parsed fault plan
+    (wired by ``engine.train`` when ``tpu_fault_inject`` is set)."""
+    plan = parse_fault_spec(spec, marker_dir)
+
+    def _callback(env) -> None:
+        plan.maybe_fire(env.iteration)
+    _callback.before_iteration = True
+    _callback.order = -100          # fire before any real callback work
+    _callback.fault_plan = plan
+    return _callback

@@ -1,0 +1,55 @@
+"""Gang-restart policy helpers for the distributed launcher.
+
+``parallel.launch.train_distributed`` owns the actual restart loop
+(terminate the gang, pick a fresh coordinator port, resume every rank
+from the newest valid rank-0 checkpoint); this module keeps the policy
+pieces — exponential backoff, bind-failure classification for the
+coordinator-port race, and the "is there anything to resume from"
+check — separately testable.
+"""
+from __future__ import annotations
+
+__all__ = ["backoff_seconds", "is_bind_failure",
+           "has_resumable_checkpoint"]
+
+# substrings (lowercased) that identify a coordinator bind failure —
+# the _free_port() race where the probed port is reclaimed between
+# close() and jax.distributed's coordinator bind
+_BIND_TOKENS = (
+    "address already in use",
+    "address in use",
+    "failed to bind",
+    "bind failed",
+    "could not bind",
+    "errno 98",           # EADDRINUSE
+    "eaddrinuse",
+)
+
+
+def backoff_seconds(attempt: int, base: float = 1.0,
+                    cap: float = 30.0) -> float:
+    """Exponential backoff for restart attempt N (1-based): base *
+    2**(N-1), capped."""
+    if attempt <= 0:
+        return 0.0
+    return float(min(cap, base * (2.0 ** (attempt - 1))))
+
+
+def is_bind_failure(err_text: str) -> bool:
+    """True when a worker error payload looks like the coordinator
+    failed to bind its port (retry on a fresh port, don't burn a
+    restart attempt)."""
+    low = str(err_text).lower()
+    return any(tok in low for tok in _BIND_TOKENS)
+
+
+def has_resumable_checkpoint(directory) -> bool:
+    """True when ``directory`` holds at least one VALID rank-0
+    checkpoint (the launcher's restart decision: resume vs from
+    scratch)."""
+    from .checkpoint import CheckpointManager
+    try:
+        mgr = CheckpointManager(directory, rank=0)
+        return mgr.latest_valid_iteration() is not None
+    except Exception:
+        return False

@@ -1,0 +1,236 @@
+"""Distributed worker recovery: ``train_distributed`` gangs with a
+fault-injected worker death must self-heal — terminate the gang, back
+off, relaunch on a fresh port, resume every rank from its newest
+per-rank checkpoint — and finish with the SAME model as the fault-free
+run (docs/robustness.md).
+
+Two tiers:
+
+* a 1-process gang (always runnable): the full launcher recovery loop
+  — death detection, backoff, fresh-port relaunch, checkpoint resume,
+  model collection — end to end;
+* the REAL 4-process gang with rank 1 SIGKILLed mid-training — the
+  acceptance check — which needs a jaxlib whose CPU backend supports
+  cross-process collectives (this container's does not: the seed's own
+  ``test_multihost`` 4-process runs fail on it), so it probes once and
+  skips cleanly where the platform cannot run ANY multi-process job.
+
+Not marked ``slow`` (this is the recovery subsystem's key CI check),
+but guarded by an in-test SIGALRM watchdog so a hung restart loop
+fails in under 120 s instead of eating the tier-1 budget.
+"""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.recovery.checkpoint import CheckpointManager
+
+# single source of truth with the other multihost tests (see
+# test_multihost.py): same data/base params -> shared compile cache
+from _multihost_worker import PARAMS, make_data  # noqa: E402
+
+ROUNDS = 12
+INTERVAL = 4
+
+
+def shard_fn(rank, nproc):
+    """Module-level so the spawned workers can unpickle it."""
+    X, y = make_data()
+    blk = len(X) // nproc
+    lo, hi = rank * blk, (rank + 1) * blk
+    return {"data": X[lo:hi], "label": y[lo:hi]}
+
+
+class _Watchdog:
+    """In-test timeout guard: SIGALRM after ``seconds`` raises instead
+    of letting a hung gang/restart loop run into the suite timeout."""
+
+    def __init__(self, seconds):
+        self.seconds = seconds
+
+    def __enter__(self):
+        def _on_alarm(signum, frame):
+            raise TimeoutError(
+                f"fault-tolerance test exceeded its {self.seconds}s "
+                f"in-test watchdog (hung restart loop?)")
+        self._old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._old)
+        return False
+
+
+def _probe_multiprocess_collectives_main(port, q):
+    """Child body for the capability probe (module-level for spawn)."""
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        rank = int(os.environ.pop("_LGBM_PROBE_RANK"))
+        jax.distributed.initialize(f"localhost:{port}", 2, rank)
+        from jax.experimental import multihost_utils
+        got = np.asarray(multihost_utils.process_allgather(
+            np.asarray([rank], np.int64))).reshape(-1)
+        q.put(("ok", sorted(got.tolist())))
+    except Exception as e:
+        q.put(("err", f"{type(e).__name__}: {e}"))
+
+
+@pytest.fixture(scope="module")
+def multiprocess_collectives():
+    """Skip marker for platforms whose CPU backend cannot run ANY
+    cross-process collective (jaxlib limitation, not a recovery bug):
+    two bare jax.distributed processes attempt one process_allgather."""
+    import multiprocessing as mp
+
+    from lightgbm_tpu.parallel.launch import _free_port
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = _free_port()
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = " ".join(
+        f for f in flags.split()
+        if "host_platform_device_count" not in f)
+    procs = []
+    try:
+        for rank in range(2):
+            os.environ["_LGBM_PROBE_RANK"] = str(rank)
+            p = ctx.Process(target=_probe_multiprocess_collectives_main,
+                            args=(port, q))
+            p.start()
+            procs.append(p)
+        results = [q.get(timeout=60) for _ in range(2)]
+    except Exception as e:
+        results = [("err", str(e))]
+    finally:
+        os.environ["XLA_FLAGS"] = flags
+        os.environ.pop("_LGBM_PROBE_RANK", None)
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.kill()
+    bad = [r for r in results if r[0] != "ok"]
+    if bad:
+        pytest.skip("this jaxlib's CPU backend cannot run multi-process "
+                    f"collectives ({bad[0][1]}); the 1-process gang "
+                    f"tests below still cover the recovery loop")
+    assert all(r[1] == [0, 1] for r in results)
+
+
+# ---------------------------------------------------------------------------
+# 1-process gang: the full launcher recovery loop, runnable everywhere
+# ---------------------------------------------------------------------------
+def test_single_process_gang_kill_self_heals(tmp_path):
+    d_ok = str(tmp_path / "ok")
+    d_fault = str(tmp_path / "fault")
+
+    with _Watchdog(115):
+        baseline = lgb.train_distributed(
+            dict(PARAMS, checkpoint_dir=d_ok,
+                 checkpoint_interval=INTERVAL),
+            shard_fn, n_processes=1, num_boost_round=ROUNDS,
+            timeout=90.0)
+        params = dict(PARAMS, checkpoint_dir=d_fault,
+                      checkpoint_interval=INTERVAL,
+                      tpu_fault_inject="kill:rank=0,iter=9")
+        healed = lgb.train_distributed(
+            params, shard_fn, n_processes=1, num_boost_round=ROUNDS,
+            timeout=90.0, max_restarts=2, restart_backoff=0.2)
+
+    # the kill really happened (fire-once marker written by rank 0) and
+    # an automatic restart resumed from the iteration-8 checkpoint
+    assert [n for n in os.listdir(d_fault)
+            if n.startswith(".fault_fired.")], "fault was never injected"
+    assert healed.num_trees() == ROUNDS
+    assert CheckpointManager(d_fault, rank=0).latest_valid_iteration() \
+        == ROUNDS
+    # bit-exact self-heal: exact score restore makes the resumed gang's
+    # model identical to the fault-free run's
+    assert healed.model_to_string() == baseline.model_to_string()
+    X, y = make_data()
+    assert np.mean((healed.predict(X) > 0.5) == y) > 0.8
+
+
+def test_cross_driver_resume_continues_previous_job(tmp_path):
+    """Re-running the SAME train_distributed call after a whole-driver
+    crash must resume from the surviving checkpoints (resume='auto'),
+    not clear them and retrain from iteration 0."""
+    ckdir = str(tmp_path / "job")
+    params = dict(PARAMS, checkpoint_dir=ckdir,
+                  checkpoint_interval=INTERVAL,
+                  tpu_fault_inject="kill:rank=0,iter=9")
+    with _Watchdog(115):
+        # "driver 1": dies with the gang (no restart budget)
+        with pytest.raises(lgb.LightGBMError):
+            lgb.train_distributed(params, shard_fn, n_processes=1,
+                                  num_boost_round=ROUNDS, timeout=90.0)
+        assert CheckpointManager(ckdir, rank=0) \
+            .latest_valid_iteration() == 8
+        # "driver 2": same call again — auto-resumes at 8, runs 8..11
+        bst = lgb.train_distributed(params, shard_fn, n_processes=1,
+                                    num_boost_round=ROUNDS, timeout=90.0)
+    assert bst.num_trees() == ROUNDS
+    assert CheckpointManager(ckdir, rank=0).latest_valid_iteration() \
+        == ROUNDS
+    # resume=True on a dir with no checkpoints must raise up front
+    with pytest.raises(lgb.LightGBMError, match="no valid rank-0"):
+        lgb.train_distributed(dict(PARAMS,
+                                   checkpoint_dir=str(tmp_path / "x")),
+                              shard_fn, n_processes=1,
+                              num_boost_round=2, resume=True)
+
+
+def test_no_restart_budget_surfaces_worker_death(tmp_path):
+    """max_restarts=0 keeps the old fail-fast contract: a killed worker
+    raises instead of silently retrying."""
+    with _Watchdog(115):
+        with pytest.raises(lgb.LightGBMError,
+                           match="no result|worker failed"):
+            lgb.train_distributed(
+                dict(PARAMS, tpu_fault_inject="kill:rank=0,iter=2"),
+                shard_fn, n_processes=1, num_boost_round=6,
+                timeout=90.0)
+
+
+# ---------------------------------------------------------------------------
+# the 4-process acceptance run (needs real multi-process collectives)
+# ---------------------------------------------------------------------------
+def test_worker_kill_4proc_self_heals_and_matches_fault_free(
+        tmp_path, multiprocess_collectives):
+    d_ok = str(tmp_path / "ok")
+    d_fault = str(tmp_path / "fault")
+
+    with _Watchdog(115):
+        baseline = lgb.train_distributed(
+            dict(PARAMS, checkpoint_dir=d_ok,
+                 checkpoint_interval=INTERVAL),
+            shard_fn, n_processes=4, num_boost_round=ROUNDS,
+            timeout=90.0)
+
+    with _Watchdog(115):
+        # rank 1 is SIGKILLed before iteration 9; checkpoints exist at
+        # 4 and 8, so the restarted gang resumes from 8 and runs 8..11
+        params = dict(PARAMS, checkpoint_dir=d_fault,
+                      checkpoint_interval=INTERVAL,
+                      tpu_fault_inject="kill:rank=1,iter=9")
+        healed = lgb.train_distributed(
+            params, shard_fn, n_processes=4, num_boost_round=ROUNDS,
+            timeout=90.0, max_restarts=2, restart_backoff=0.2)
+
+    assert [n for n in os.listdir(d_fault)
+            if n.startswith(".fault_fired.")], "fault was never injected"
+    assert healed.num_trees() == ROUNDS
+    # every rank checkpointed past the resume point after the restart
+    for rank in range(4):
+        assert CheckpointManager(d_fault, rank=rank) \
+            .latest_valid_iteration() == ROUNDS
+    # bit-exact self-heal: per-rank exact score restore makes the
+    # resumed gang's model identical to the fault-free run's
+    assert healed.model_to_string() == baseline.model_to_string()
+    X, y = make_data()
+    assert np.mean((healed.predict(X) > 0.5) == y) > 0.8

@@ -211,6 +211,42 @@ def test_tpu_debug_catches_nan_labels_on_device():
                   lgb.Dataset(X, label=y), num_boost_round=3)
 
 
+def test_tpu_debug_catches_nan_hessian_custom_objective():
+    """The custom-fobj host-side validation must flag non-finite
+    HESSIANS with the documented diagnostic too (a silent NaN hessian
+    would corrupt every leaf output downstream)."""
+    X, y = _data(seed=21)
+
+    def bad_fobj(preds, ds):
+        g = preds - ds.get_label()
+        h = np.ones_like(g)
+        h[5] = np.inf
+        return g, h
+
+    with pytest.raises(lgb.LightGBMError,
+                       match="non-finite hessian"):
+        lgb.train({"objective": "custom", "tpu_debug": True,
+                   "num_leaves": 15, "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3,
+                  fobj=bad_fobj)
+
+
+def test_tpu_debug_catches_out_of_range_init_score():
+    """An out-of-range (non-finite) init_score poisons the model scores
+    before the first gradient; the checkify pass must surface the
+    documented score diagnostic instead of silently training NaN
+    trees."""
+    X, y = _data(seed=22)
+    init = np.zeros(len(y))
+    init[7] = np.inf
+    with pytest.raises(lgb.LightGBMError,
+                       match="model scores contain"):
+        lgb.train({"objective": "binary", "tpu_debug": True,
+                   "num_leaves": 15, "verbosity": -1},
+                  lgb.Dataset(X, label=y, init_score=init),
+                  num_boost_round=3)
+
+
 def test_tpu_debug_clean_run_unaffected():
     X, y = _data(seed=10)
     a = lgb.train({"objective": "regression", "num_leaves": 15,

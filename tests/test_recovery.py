@@ -1,0 +1,426 @@
+"""Fault-tolerant training: durable checkpoint/resume (recovery
+subsystem; docs/robustness.md).
+
+The contract under test is STRONGER than init_model continuation: a
+checkpoint persists the complete training state — model text, RNG
+streams (bagging / feature fraction / DART drop), the exact score
+arrays, early-stopping best-score state — so an
+interrupted-then-resumed run reproduces the uninterrupted run's model
+BIT-EXACTLY (``model_to_string`` equality, not allclose).
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.recovery.checkpoint import (CheckpointError,
+                                              CheckpointManager)
+from lightgbm_tpu.recovery.faults import parse_fault_spec
+from lightgbm_tpu.recovery.restart import (backoff_seconds,
+                                           has_resumable_checkpoint,
+                                           is_bind_failure)
+
+
+def _binary_data(n=2500, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = (X @ w + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+# bagging + GOSS + feature sampling + early stopping all enabled so the
+# RNG/best-score state the checkpoint must carry is actually exercised
+# (GOSS activates at iteration 1/learning_rate = 10, so bagging governs
+# iterations 0-9 and GOSS the rest)
+FULL_PARAMS = {
+    "objective": "binary", "num_leaves": 15, "verbosity": -1,
+    "learning_rate": 0.1, "data_sample_strategy": "goss",
+    "top_rate": 0.3, "other_rate": 0.2,
+    "bagging_freq": 2, "bagging_fraction": 0.8,
+    "feature_fraction": 0.8, "metric": "auc",
+    "early_stopping_round": 25,
+}
+
+
+def _train_val():
+    X, y = _binary_data()
+    ds = lgb.Dataset(X[:2000], label=y[:2000])
+    vs = ds.create_valid(X[2000:], label=y[2000:])
+    return ds, vs
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: atomicity, checksum, retention, latest pointer
+# ---------------------------------------------------------------------------
+def test_checkpoint_roundtrip_and_latest_pointer(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_n=3, rank=0)
+    for it in (5, 10, 15):
+        mgr.save({"version": 1, "payload": it * 11}, it)
+    assert mgr.iterations() == [5, 10, 15]
+    with open(mgr.latest_pointer) as f:
+        assert f.read().strip() == mgr.filename(15)
+    st = mgr.load()
+    assert st["payload"] == 165 and st["iteration"] == 15
+    assert mgr.load(iteration=5)["payload"] == 55
+
+
+def test_checkpoint_keep_n_prunes_oldest(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_n=2, rank=0)
+    for it in range(1, 6):
+        mgr.save({"version": 1, "n": it}, it)
+    assert mgr.iterations() == [4, 5]
+    assert mgr.load()["n"] == 5
+
+
+def test_prune_removes_stale_higher_iterations(tmp_path):
+    """A reused directory with a previous run's higher-iteration
+    checkpoints: the first save of the new run must evict them (they
+    would otherwise win every resume) and must never prune itself."""
+    mgr = CheckpointManager(tmp_path, keep_n=3, rank=0)
+    for it in (12, 16, 20):
+        mgr.save({"version": 1, "n": it}, it)
+    mgr.save({"version": 1, "n": 4}, 4)
+    assert mgr.iterations() == [4]
+    assert mgr.load()["n"] == 4
+
+
+def test_fresh_train_run_clears_stale_checkpoint_dir(tmp_path):
+    """A fresh (non-resume) train() into a dir holding another run's
+    checkpoints must clear them — a later restart would otherwise
+    silently continue the OLD run's state."""
+    X, y = _binary_data(n=1000, seed=9)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "checkpoint_dir": str(tmp_path), "checkpoint_interval": 2}
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+    mgr = CheckpointManager(tmp_path, rank=0)
+    assert mgr.latest_valid_iteration() == 6
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert mgr.iterations() == [2]          # 4/6 from the old run gone
+
+
+def test_truncated_checkpoint_rejected_and_falls_back(tmp_path):
+    """Acceptance: a checkpoint truncated mid-write is rejected by
+    checksum and resume falls back to the previous valid one."""
+    mgr = CheckpointManager(tmp_path, keep_n=5, rank=0)
+    mgr.save({"version": 1, "n": 10}, 10)
+    p20 = mgr.save({"version": 1, "n": 20}, 20)
+    blob = open(p20, "rb").read()
+    with open(p20, "wb") as f:          # simulate a torn write
+        f.write(blob[:len(blob) // 2])
+    with pytest.raises(CheckpointError, match="truncated|checksum"):
+        mgr.load_file(p20)
+    st = mgr.load()                     # pointer names 20 -> falls back
+    assert st["n"] == 10
+    assert mgr.latest_valid_iteration() == 10
+    # corrupted-in-place (same length, flipped bytes) fails the sha256
+    corrupt = blob[:-8] + bytes(8)
+    with open(p20, "wb") as f:
+        f.write(corrupt)
+    with pytest.raises(CheckpointError, match="checksum"):
+        mgr.load_file(p20)
+
+
+def test_checkpoint_bad_magic_and_version(tmp_path):
+    mgr = CheckpointManager(tmp_path, rank=0)
+    p = tmp_path / "ckpt_00000001.rank0.ckpt"
+    p.write_bytes(b"not a checkpoint at all")
+    with pytest.raises(CheckpointError, match="magic"):
+        mgr.load_file(str(p))
+    mgr.save({"version": 99}, 2)
+    with pytest.raises(CheckpointError, match="version"):
+        mgr.load_file(mgr.path(2))
+
+
+def test_checkpoint_write_leaves_no_temp_litter(tmp_path):
+    mgr = CheckpointManager(tmp_path, rank=0)
+    mgr.save({"version": 1, "big": np.zeros(4096)}, 1)
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp.")]
+
+
+# ---------------------------------------------------------------------------
+# fault-injection spec parsing + fire-once markers
+# ---------------------------------------------------------------------------
+def test_fault_spec_parse_and_validation():
+    plan = parse_fault_spec("kill:rank=1,iter=10")
+    assert (plan.kind, plan.rank, plan.iteration) == ("kill", 1, 10)
+    plan = parse_fault_spec("exn:iter=5")
+    assert (plan.kind, plan.rank, plan.iteration) == ("exn", None, 5)
+    for bad in ("boom:iter=1", "exn:rank=1", "exn:iter=x", "exn:foo=1"):
+        with pytest.raises(lgb.LightGBMError):
+            parse_fault_spec(bad)
+
+
+def test_fault_exn_fires_once_with_marker(tmp_path):
+    plan = parse_fault_spec("exn:iter=3", marker_dir=str(tmp_path))
+    plan.maybe_fire(2)                      # wrong iteration: no-op
+    with pytest.raises(lgb.LightGBMError, match="injected failure"):
+        plan.maybe_fire(3)
+    plan.maybe_fire(3)                      # marker written: skipped
+    # without a marker dir the fault fires on every matching pass
+    plan2 = parse_fault_spec("exn:iter=3")
+    for _ in range(2):
+        with pytest.raises(lgb.LightGBMError):
+            plan2.maybe_fire(3)
+
+
+# ---------------------------------------------------------------------------
+# restart policy helpers
+# ---------------------------------------------------------------------------
+def test_restart_policy_helpers(tmp_path):
+    assert backoff_seconds(1, base=0.5) == 0.5
+    assert backoff_seconds(3, base=0.5) == 2.0
+    assert backoff_seconds(30, base=1.0) == 30.0          # capped
+    assert is_bind_failure("RuntimeError: Failed to bind any address")
+    assert is_bind_failure("bind: Address already in use (errno 98)")
+    assert not is_bind_failure("rank 2: ValueError: shapes mismatch")
+    assert not has_resumable_checkpoint(tmp_path)          # empty dir
+    CheckpointManager(tmp_path, rank=0).save({"version": 1}, 4)
+    assert has_resumable_checkpoint(tmp_path)
+    assert not has_resumable_checkpoint(tmp_path / "missing")
+
+
+# ---------------------------------------------------------------------------
+# the tentpole acceptance test: interrupted-then-resumed == uninterrupted,
+# bit-exact, with bagging + GOSS + early stopping in play
+# ---------------------------------------------------------------------------
+def test_bit_exact_resume_after_midtraining_kill(tmp_path):
+    d_straight = str(tmp_path / "straight")
+    d_faulted = str(tmp_path / "faulted")
+
+    ds, vs = _train_val()
+    params = dict(FULL_PARAMS, checkpoint_dir=d_straight,
+                  checkpoint_interval=10)
+    straight = lgb.train(params, ds, num_boost_round=30, valid_sets=[vs])
+    m_straight = straight.model_to_string()
+    assert straight.num_trees() == 30
+
+    # interrupted run: injected failure before iteration 17 (checkpoint
+    # at 10 exists, 20 does not)
+    ds, vs = _train_val()
+    params = dict(FULL_PARAMS, checkpoint_dir=d_faulted,
+                  checkpoint_interval=10, tpu_fault_inject="exn:iter=17")
+    with pytest.raises(lgb.LightGBMError, match="injected failure"):
+        lgb.train(params, ds, num_boost_round=30, valid_sets=[vs])
+    assert CheckpointManager(d_faulted, rank=0).latest_valid_iteration() \
+        == 10
+
+    # resume with the SAME params (the fire-once marker in the
+    # checkpoint dir keeps the fault from replaying) — the total-round
+    # target semantics run iterations 10..29
+    ds, vs = _train_val()
+    resumed = lgb.train(params, ds, num_boost_round=30, valid_sets=[vs],
+                        resume_from=d_faulted)
+    assert resumed.num_trees() == 30
+    assert resumed.model_to_string() == m_straight
+    assert resumed.best_iteration == straight.best_iteration
+    assert resumed.best_score == straight.best_score
+
+
+def test_resume_falls_back_past_corrupt_newest_checkpoint(tmp_path):
+    """Kill after the 20-checkpoint, corrupt it, and resume: the loader
+    must fall back to 10 and still reproduce the straight run exactly."""
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    ds, vs = _train_val()
+    straight = lgb.train(dict(FULL_PARAMS, checkpoint_dir=d1,
+                              checkpoint_interval=10),
+                         ds, num_boost_round=30, valid_sets=[vs])
+    ds, vs = _train_val()
+    params = dict(FULL_PARAMS, checkpoint_dir=d2, checkpoint_interval=10,
+                  tpu_fault_inject="exn:iter=25")
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train(params, ds, num_boost_round=30, valid_sets=[vs])
+    mgr = CheckpointManager(d2, rank=0)
+    assert mgr.latest_valid_iteration() == 20
+    blob = open(mgr.path(20), "rb").read()
+    with open(mgr.path(20), "wb") as f:
+        f.write(blob[:len(blob) - 64])       # torn tail
+    ds, vs = _train_val()
+    resumed = lgb.train(params, ds, num_boost_round=30, valid_sets=[vs],
+                        resume_from=d2)
+    assert resumed.model_to_string() == straight.model_to_string()
+
+
+def test_resume_from_empty_dir_starts_fresh(tmp_path):
+    ds, _ = _train_val()
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    bst = lgb.train(params, ds, num_boost_round=3,
+                    resume_from=str(tmp_path))
+    assert bst.num_trees() == 3
+
+
+def test_resume_from_mistyped_checkpoint_file_raises(tmp_path):
+    """A nonexistent path that LOOKS like a checkpoint file is a typo,
+    not a fresh start — silently retraining would discard the run the
+    user asked to continue."""
+    ds, _ = _train_val()
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    with pytest.raises(CheckpointError, match="does not exist"):
+        lgb.train(params, ds, num_boost_round=3,
+                  resume_from=str(tmp_path / "ckpt_00000010.rank0.ckpt"))
+    assert not (tmp_path / "ckpt_00000010.rank0.ckpt").exists()
+
+
+def test_resume_with_changed_metric_layout_degrades_gracefully(tmp_path):
+    """Resuming with a different metric list must not crash the
+    restored early-stopping state (best-effort reinit, like the score
+    rebuild fallback)."""
+    ds, vs = _train_val()
+    params = dict(FULL_PARAMS, checkpoint_dir=str(tmp_path),
+                  checkpoint_interval=5, tpu_fault_inject="exn:iter=8")
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train(params, ds, num_boost_round=12, valid_sets=[vs])
+    ds, vs = _train_val()
+    changed = dict(params, metric=["auc", "binary_logloss"])
+    bst = lgb.train(changed, ds, num_boost_round=12, valid_sets=[vs],
+                    resume_from=str(tmp_path))
+    assert bst.num_trees() == 12
+
+
+def test_dart_resume_bit_exact(tmp_path):
+    """DART drop-RNG + per-iteration weights survive the checkpoint (the
+    lossy lr-seeding of plain init_model continuation would diverge)."""
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    base = {"objective": "binary", "boosting": "dart", "num_leaves": 15,
+            "verbosity": -1, "drop_rate": 0.5, "skip_drop": 0.2,
+            "checkpoint_interval": 5}
+    X, y = _binary_data(n=1500, seed=3)
+    straight = lgb.train(dict(base, checkpoint_dir=d1),
+                         lgb.Dataset(X, label=y), num_boost_round=14)
+    params = dict(base, checkpoint_dir=d2, tpu_fault_inject="exn:iter=8")
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=14)
+    resumed = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=14, resume_from=d2)
+    assert resumed.model_to_string() == straight.model_to_string()
+
+
+def test_rf_resume_bit_exact(tmp_path):
+    """RF keeps running prediction-sum accumulators next to the bagging
+    RNG; both must survive the checkpoint."""
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    base = {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+            "bagging_freq": 1, "bagging_fraction": 0.7, "verbosity": -1,
+            "checkpoint_interval": 4}
+    X, y = _binary_data(n=1500, seed=4)
+    straight = lgb.train(dict(base, checkpoint_dir=d1),
+                         lgb.Dataset(X, label=y), num_boost_round=10)
+    params = dict(base, checkpoint_dir=d2, tpu_fault_inject="exn:iter=6")
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    resumed = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=10, resume_from=d2)
+    assert resumed.model_to_string() == straight.model_to_string()
+
+
+def test_early_stopping_metric_freq_gap_not_mistaken_for_mismatch():
+    """Non-eval iterations (metric_freq > 1) produce empty evaluation
+    lists; the checkpoint-layout-mismatch reinit must not fire on them
+    (it would clear best-score tracking every other iteration)."""
+    from lightgbm_tpu.utils import log as _log
+    lines = []
+    _log.register_callback(lines.append)
+    try:
+        ds, vs = _train_val()
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": 1, "metric": "auc",
+                         "metric_freq": 2, "early_stopping_round": 3},
+                        ds, num_boost_round=8, valid_sets=[vs])
+    finally:
+        _log.register_callback(None)
+    assert bst.num_trees() >= 1
+    assert not [ln for ln in lines if "does not match" in ln], lines
+
+
+def test_resume_rejects_engine_type_mismatch(tmp_path):
+    """A DART checkpoint resumed with boosting=gbdt must fatal (the
+    DART drop state would be silently dropped otherwise)."""
+    X, y = _binary_data(n=1500, seed=5)
+    params = {"objective": "binary", "boosting": "dart", "num_leaves": 7,
+              "verbosity": -1, "checkpoint_dir": str(tmp_path),
+              "checkpoint_interval": 2}
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4)
+    wrong = dict(params)
+    del wrong["boosting"]
+    with pytest.raises(lgb.LightGBMError, match="DART engine"):
+        lgb.train(wrong, lgb.Dataset(X, label=y), num_boost_round=6,
+                  resume_from=str(tmp_path))
+
+
+def test_checkpoint_state_is_picklable_and_complete(tmp_path):
+    """The saved engine state names every piece the resume contract
+    advertises (guards against silently dropping a field later)."""
+    ds, vs = _train_val()
+    params = dict(FULL_PARAMS, checkpoint_dir=str(tmp_path),
+                  checkpoint_interval=5)
+    # NB: 10 rounds, not 5 — the early-stopping callback raises its
+    # "did not meet" EarlyStopException ON the final iteration before
+    # the (later-ordered) checkpoint callback runs, so the final
+    # iteration of a completed run is deliberately not checkpointed
+    lgb.train(params, ds, num_boost_round=10, valid_sets=[vs])
+    st = CheckpointManager(str(tmp_path), rank=0).load()
+    assert st["iteration"] == 5
+    assert "Tree=0" in st["model_str"]
+    eng = st["engine"]
+    for key in ("iteration", "init_scores", "rng_feature", "rng_bagging",
+                "score", "valid_scores", "bag_mask"):
+        assert key in eng, key
+    assert eng["score"].dtype == np.float32
+    assert len(eng["valid_scores"]) == 1
+    assert "early_stopping" in st["callbacks"]
+    pickle.dumps(st)                         # full payload round-trips
+
+
+# ---------------------------------------------------------------------------
+# init_multihost: transient-connect retries + broad error wrapping
+# ---------------------------------------------------------------------------
+def test_init_multihost_retries_transient_connect(monkeypatch):
+    import jax
+
+    from lightgbm_tpu.parallel.multihost import init_multihost
+    calls = {"n": 0}
+
+    def flaky_initialize(**kwargs):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("connection refused: coordinator "
+                                  "not up yet")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_initialize)
+    init_multihost("localhost:1", 1, 0, connect_retries=3,
+                   retry_backoff=0.01)
+    assert calls["n"] == 3
+
+
+def test_init_multihost_wraps_timeout_errors(monkeypatch):
+    import jax
+
+    from lightgbm_tpu.parallel.multihost import init_multihost
+
+    def timeout_initialize(**kwargs):
+        raise TimeoutError("deadline exceeded waiting for coordinator")
+
+    monkeypatch.setattr(jax.distributed, "initialize", timeout_initialize)
+    with pytest.raises(lgb.LightGBMError, match="initialize failed"):
+        init_multihost("localhost:1", 1, 0, connect_retries=1,
+                       retry_backoff=0.01)
+
+
+def test_init_multihost_no_retry_on_non_transient(monkeypatch):
+    import jax
+
+    from lightgbm_tpu.parallel.multihost import init_multihost
+    calls = {"n": 0}
+
+    def misuse_initialize(**kwargs):
+        calls["n"] += 1
+        raise RuntimeError("jax.distributed.initialize was already "
+                           "called")
+
+    monkeypatch.setattr(jax.distributed, "initialize", misuse_initialize)
+    with pytest.raises(lgb.LightGBMError):
+        init_multihost("localhost:1", 1, 0, connect_retries=3,
+                       retry_backoff=0.01)
+    assert calls["n"] == 1
