@@ -121,7 +121,7 @@ def peak_hbm_gib():
 
 
 def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
-               cat_features="auto"):
+               cat_features="auto", measure_predict=True):
     """Train warmup+iters rounds, AUC there, then median of N timed
     windows of the same chunk length."""
     import jax
@@ -162,12 +162,27 @@ def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
     # configs), between the timed windows so it inflates none of them
     pred = eng.predict(X_ho)
     auc = AUCMetric(cfg).eval(pred, y_ho, None)[0][1]
+    # serving throughput (the inference engine's steady state: cached
+    # device forest + bucketed batch shapes; benchmarks/predict_bench.py
+    # has the full grid): median rows/sec over repeat 10k-row predicts,
+    # after the warm call above — main config only, the continuity/
+    # guard runs discard it
+    predict_rps = None
+    if measure_predict:
+        n_pred = min(10_000, len(X_ho))
+        eng.predict(X_ho[:n_pred])                # warm this bucket
+        pred_rates = []
+        for _ in range(3):
+            t0 = time.time()
+            eng.predict(X_ho[:n_pred])
+            pred_rates.append(n_pred / (time.time() - t0))
+        predict_rps = statistics.median(pred_rates)
     for _ in range(windows - 1):
         t0 = time.time()
         eng.train_chunk(iters)
         jax.block_until_ready(eng.score)
         rates.append(iters / (time.time() - t0))
-    return statistics.median(rates), auc, bin_time
+    return statistics.median(rates), auc, bin_time, predict_rps
 
 
 def main():
@@ -227,14 +242,16 @@ def main():
     if args.precise:
         params["tpu_double_precision_hist"] = True
 
-    ips, auc, bin_time = run_config(X, y, X_ho, y_ho, params,
-                                    args.iters, args.warmup,
-                                    args.windows)
+    ips, auc, bin_time, predict_rps = run_config(X, y, X_ho, y_ho,
+                                                 params, args.iters,
+                                                 args.warmup,
+                                                 args.windows)
 
     extras = "; goss" if args.goss else "; full-rows"
     if args.quant:
         extras += "+quantized"
     extras += f"; median-of-{args.windows}"
+    extras += f"; predict_rps={predict_rps:.0f}"
 
     # continuity figure: the rounds-1..3 headline config (higgs-1M,
     # plain full-row f32) timed in the same process on the main run's
@@ -247,9 +264,9 @@ def main():
               "verbosity": -1, "use_quantized_grad": False}
         # 40-iteration chunks: shorter ones fall below tpu_fuse_iters
         # and pay per-iteration dispatch (measured 2x slower)
-        ips1, auc1, _ = run_config(
+        ips1, auc1, _, _ = run_config(
             X[:n1], y[:n1], X_ho[:100_000], y_ho[:100_000], p1,
-            40, 50, windows=3)
+            40, 50, windows=3, measure_predict=False)
         extras += f"; plain1m={ips1:.2f}@auc{auc1:.4f}(median-of-3)"
 
     # categorical/NaN/interaction guard (see module docstring)
@@ -257,10 +274,11 @@ def main():
         Xg, yg = synth_guard(250_000)
         gp = {"objective": "binary", "num_leaves": 63, "max_bin": 255,
               "learning_rate": 0.1, "verbosity": -1}
-        g_ips, g_auc, _ = run_config(Xg[:200_000], yg[:200_000],
-                                     Xg[200_000:], yg[200_000:], gp,
-                                     10, 40, windows=1,
-                                     cat_features=[10, 11])
+        g_ips, g_auc, _, _ = run_config(Xg[:200_000], yg[:200_000],
+                                        Xg[200_000:], yg[200_000:], gp,
+                                        10, 40, windows=1,
+                                        cat_features=[10, 11],
+                                        measure_predict=False)
         extras += f"; guard2_auc={g_auc:.4f}"
         if g_auc < 0.85:
             extras += " GUARD2_BELOW_FLOOR(0.85)"

@@ -1,0 +1,128 @@
+"""Serving benchmark: prediction rows/sec and per-call latency.
+
+Grid: batch sizes {1, 128, 10k, 1M} x forest sizes {50, 500} trees
+(overridable), reporting FIRST-CALL latency (compile + stack + upload)
+separately from STEADY-STATE per-call latency and rows/sec — the
+serving numbers docs/perf.md's "Serving" section records. ``--legacy``
+times the pre-PR path (per-tree scan traversal, no bucketing, no
+stacked-forest cache) for the speedup ratio.
+
+Run:
+  python benchmarks/predict_bench.py                 # full grid
+  python benchmarks/predict_bench.py --trees 200 --batches 10000
+  python benchmarks/predict_bench.py --legacy        # pre-PR baseline
+  python benchmarks/predict_bench.py --compare       # both paths, one
+                                                     # trained model,
+                                                     # speedup ratios
+
+Each line is one JSON record; the final line aggregates.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _train_booster(n_rows, n_feat, trees, num_leaves, seed=0):
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_rows, n_feat))
+    w = rng.normal(size=n_feat)
+    y = ((X @ w + 0.5 * X[:, 0] * X[:, 1]
+          + rng.normal(scale=0.5, size=n_rows)) > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    return lgb.train({"objective": "binary", "num_leaves": num_leaves,
+                      "learning_rate": 0.1, "verbosity": -1},
+                     ds, num_boost_round=trees)
+
+
+def bench_batch(bst, X, batch, legacy, min_steady_s=1.0, max_calls=50):
+    """One (model, batch) cell: first call, then timed steady calls."""
+    rng = np.random.default_rng(1)
+    Xb = X[rng.integers(0, len(X), size=batch)]
+    kwargs = ({"tpu_predict_parallel_trees": False,
+               "tpu_predict_buckets": False} if legacy else {})
+    if legacy:
+        # the pre-PR path also re-stacked the forest every call
+        bst.engine.config.tpu_predict_cache = False
+    t0 = time.time()
+    bst.predict(Xb, raw_score=True, **kwargs)
+    first_s = time.time() - t0
+    lat = []
+    t_all = 0.0
+    for _ in range(max_calls):
+        t0 = time.time()
+        bst.predict(Xb, raw_score=True, **kwargs)
+        dt = time.time() - t0
+        lat.append(dt)
+        t_all += dt
+        if t_all > min_steady_s and len(lat) >= 3:
+            break
+    if legacy:
+        bst.engine.config.tpu_predict_cache = True
+    med = sorted(lat)[len(lat) // 2]
+    return {"first_call_s": round(first_s, 4),
+            "steady_latency_s": round(med, 5),
+            "steady_rows_per_sec": round(batch / med, 1),
+            "steady_calls": len(lat)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trees", type=str, default="50,500")
+    ap.add_argument("--batches", type=str, default="1,128,10000,1000000")
+    ap.add_argument("--rows-train", type=int, default=20000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--num-leaves", type=int, default=31)
+    ap.add_argument("--legacy", action="store_true",
+                    help="pre-PR path: per-tree scan traversal, no "
+                         "bucketing, no stacked-forest cache")
+    ap.add_argument("--compare", action="store_true",
+                    help="bench BOTH paths on one trained model and "
+                         "report per-cell speedup ratios")
+    args = ap.parse_args()
+    trees_list = [int(t) for t in args.trees.split(",")]
+    batches = [int(b) for b in args.batches.split(",")]
+    paths = ([False, True] if args.compare
+             else [bool(args.legacy)])      # legacy flag per path
+
+    rng = np.random.default_rng(2)
+    X_pool = rng.normal(size=(max(min(max(batches), 100000), 1000),
+                              args.features))
+
+    results = []
+    for trees in trees_list:
+        t0 = time.time()
+        bst = _train_booster(args.rows_train, args.features, trees,
+                             args.num_leaves)
+        train_s = time.time() - t0
+        for batch in batches:
+            cells = {}
+            for legacy in paths:
+                name = "legacy-scan" if legacy else "tree-parallel"
+                cell = bench_batch(bst, X_pool, batch, legacy)
+                cells[name] = cell
+                rec = {"trees": trees, "batch": batch, "path": name,
+                       **cell}
+                results.append(rec)
+                print(json.dumps(rec), flush=True)
+            if len(cells) == 2:
+                ratio = (cells["tree-parallel"]["steady_rows_per_sec"]
+                         / cells["legacy-scan"]["steady_rows_per_sec"])
+                print(json.dumps({"trees": trees, "batch": batch,
+                                  "speedup_vs_legacy":
+                                  round(ratio, 2)}), flush=True)
+        print(json.dumps({"trees": trees, "train_s": round(train_s, 1)}),
+              flush=True)
+    best = max(results, key=lambda r: r["steady_rows_per_sec"])
+    print(json.dumps({"metric": "predict_rows_per_sec_best",
+                      "value": best["steady_rows_per_sec"],
+                      "path": best["path"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,12 @@ class Booster:
         # rebuild jitted step so learning-rate etc. take effect
         self.engine.config = self.config
         self.engine._build_step()
+        # a cached host model may bake the old params (e.g. sigmoid):
+        # invalidate the booster-level cache AND the engine-level one
+        # the linear-tree predict path keeps
+        self._params_version = getattr(self, "_params_version", 0) + 1
+        if hasattr(self.engine, "_invalidate_forest_cache"):
+            self.engine._invalidate_forest_cache()
         return self
 
     # ------------------------------------------------------------------
@@ -165,15 +171,38 @@ class Booster:
                 data, raw_score=raw_score, start_iteration=start_iteration,
                 num_iteration=num_iteration, pred_leaf=pred_leaf,
                 pred_contrib=pred_contrib, **es_kwargs)
+        # upstream convention: extra predict kwargs act as per-call
+        # parameter overrides — forward the serving knobs to the engine
+        serving_kwargs = {k: v for k, v in _kwargs.items()
+                          if k.startswith("tpu_predict_")}
         return self.engine.predict(
             data, raw_score=raw_score, start_iteration=start_iteration,
-            num_iteration=num_iteration or -1, pred_leaf=pred_leaf)
+            num_iteration=num_iteration or -1, pred_leaf=pred_leaf,
+            **serving_kwargs)
 
     # ------------------------------------------------------------------
     def _to_host_model(self):
+        """Engine trees -> HostModel, cached until the model changes.
+
+        Repeated ``pred_contrib``/``pred_early_stop`` predicts (and
+        ``dump_model``/``model_to_string`` reads) reuse one host model
+        instead of rebuilding it from the engine's trees each call. The
+        key tracks the engine's model count AND mutation version
+        (DART/RF rescale leaves in place without changing the count)
+        plus ``best_iteration`` and the booster's param version
+        (``reset_parameter`` can change values the host model bakes
+        in), all of which the built model depends on."""
+        eng = self.engine
+        key = (len(eng.models), getattr(eng, "_models_version", -1),
+               self.best_iteration, getattr(self, "_params_version", 0))
+        cached = getattr(self, "_host_model_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         from .io.model_text import HostModel
-        return HostModel.from_engine(self.engine, self.config,
-                                     best_iteration=self.best_iteration)
+        hm = HostModel.from_engine(eng, self.config,
+                                   best_iteration=self.best_iteration)
+        self._host_model_cache = (key, hm)
+        return hm
 
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,

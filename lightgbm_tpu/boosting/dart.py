@@ -162,6 +162,9 @@ class DART(GBDT):
             self._iter_weights[int(i)] *= old_mult
         self._iter_weights.append(lr * new_mult)
         self._sum_weight = float(np.sum(self._iter_weights))
+        # the rescales mutated stored trees in place: cached device
+        # stacks (and cached host models) must not serve the old leaves
+        self._invalidate_forest_cache()
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:

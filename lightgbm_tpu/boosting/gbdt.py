@@ -58,6 +58,24 @@ def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _next_pow2(x: int) -> int:
+    return 1 << max(x - 1, 0).bit_length()
+
+
+def _predict_row_bucket(n: int, cap: int) -> int:
+    """Pad a predict batch up to the nearest power-of-two row bucket
+    (floor 128), capped at the chunk size — arbitrary request sizes then
+    hit a BOUNDED traversal compile cache (<= log2(cap/128) programs)
+    instead of one program per distinct n."""
+    b = max(_next_pow2(max(n, 1)), 128)
+    return b if b <= cap else cap
+
+# stacked-forest cache entries kept per engine (distinct (start, num,
+# pad) tree ranges in flight at once — full model + a few early-stop
+# slices; each entry is only T * Ln * ~10 ints of HBM)
+_STACK_CACHE_ENTRIES = 8
+
+
 class _DeviceData:
     """Device-resident binned data + metadata for one dataset.
 
@@ -297,6 +315,12 @@ class GBDT:
         self.models: List[Tree] = []
         self.iter_ = 0
         self.average_output = False  # RF subclass sets True
+        # stacked-forest device cache bookkeeping: _models_version bumps
+        # on ANY model mutation (growth, rollback, state import, DART/RF
+        # leaf rescales) so cached device stacks can never serve stale
+        # leaf values (_stack_model_list)
+        self._models_version = 0
+        self._stack_cache: Optional[Tuple[Tuple[int, int], Dict]] = None
 
         n_shards = self.mesh.devices.size if self.mesh is not None else 1
         n_rows_layout = self.train_set.num_data
@@ -1772,6 +1796,7 @@ class GBDT:
             self._cegb_U = cegb_U_new
         if self.linear_tree and grad is None:
             self._apply_linear_fit(leaf_ids, score_pre)
+            self._invalidate_forest_cache()   # leaves refined in place
         if self.config.tpu_debug_checks:
             # NaN/inf guard (aux failure-detection subsystem): catch
             # divergence at the iteration that produced it
@@ -1873,6 +1898,14 @@ class GBDT:
                     self._cegb_used[newly] = True
                     self._cegb_pen_cache = None   # refresh on next step
             self.models.append(t)
+        self._invalidate_forest_cache()
+
+    def _invalidate_forest_cache(self) -> None:
+        """The model list changed (or trees mutated in place): drop the
+        stacked-forest device cache and bump the version every consumer
+        keys on (engine predict, Booster._to_host_model)."""
+        self._models_version = getattr(self, "_models_version", 0) + 1
+        self._stack_cache = None
 
     def can_fuse_iters(self) -> bool:
         """True when boosting iterations are expressible as one scanned
@@ -2029,6 +2062,7 @@ class GBDT:
             log.fatal("checkpoint state holds no model trees — corrupt "
                       "or incompatible checkpoint")
         self.models = list(models)
+        self._invalidate_forest_cache()
         self.iter_ = len(self.models) // self.num_class
         if int(state["iteration"]) != self.iter_:
             log.fatal(
@@ -2095,6 +2129,7 @@ class GBDT:
         if self.iter_ == 0:
             return
         self.models = self.models[:-self.num_class]
+        self._invalidate_forest_cache()
         self.iter_ -= 1
         self._recompute_scores()
 
@@ -2122,14 +2157,47 @@ class GBDT:
         return self._stack_model_list(list(range(start, start + num)))
 
     def _stack_model_list(self, indices: List[int], pad_count: int = 0,
-                          pad_leaves: int = 0):
+                          pad_leaves: int = 0, use_cache=None):
         """Stack an arbitrary subset of host trees into device arrays
         (DART needs non-contiguous dropped-tree subsets).
 
         ``pad_count``/``pad_leaves`` stabilize the stacked SHAPES so the
         consumer jit does not recompile per distinct subset: the stack is
         padded to ``pad_count`` single-leaf zero-value trees (inert under
-        traversal) and every per-tree array to ``pad_leaves`` slots."""
+        traversal) and every per-tree array to ``pad_leaves`` slots.
+
+        Contiguous index ranges are memoized on the engine (the
+        stacked-forest device cache, keyed by (model count+version,
+        start, num, pad shape)): repeat ``predict`` calls on an
+        unchanged model reuse the device-resident stack — zero host
+        re-stacking, zero HBM re-upload. ``_invalidate_forest_cache``
+        drops it on any model mutation; DART's random drop subsets are
+        non-contiguous and bypass it."""
+        if use_cache is None:
+            use_cache = bool(getattr(self.config, "tpu_predict_cache",
+                                     True))
+        key = None
+        if (use_cache and indices
+                and list(indices) == list(range(indices[0],
+                                                indices[0] + len(indices)))):
+            key = (indices[0], len(indices), int(pad_count),
+                   int(pad_leaves))
+            ver = (len(self.models), self._models_version)
+            cache = self._stack_cache
+            if cache is not None and cache[0] == ver:
+                hit = cache[1].get(key)
+                if hit is not None:
+                    # LRU refresh: re-insert so slice-shape churn can
+                    # never evict the hot full-model entry (tolerate a
+                    # concurrent pop — threaded serving must not crash)
+                    try:
+                        cache[1][key] = cache[1].pop(key)
+                    except KeyError:
+                        pass
+                    return hit
+        # observable for the zero-restack serving guarantee (tests pin
+        # that warm predicts never reach this point)
+        self._stack_builds = getattr(self, "_stack_builds", 0) + 1
         trees = [self.models[i] for i in indices]
         n_real = len(trees)
         n_pad = max(pad_count, n_real)
@@ -2175,6 +2243,14 @@ class GBDT:
         class_idx = jnp.asarray(np.asarray(
             list(indices) + [0] * (n_pad - n_real),
             dtype=np.int32) % self.num_class)
+        if key is not None:
+            cache = self._stack_cache
+            if cache is None or cache[0] != ver:
+                cache = (ver, {})
+                self._stack_cache = cache
+            if len(cache[1]) >= _STACK_CACHE_ENTRIES:
+                cache[1].pop(next(iter(cache[1])))
+            cache[1][key] = (stacked, class_idx)
         return stacked, class_idx
 
     # ------------------------------------------------------------------
@@ -2206,15 +2282,22 @@ class GBDT:
     # ------------------------------------------------------------------
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
-                pred_leaf: bool = False) -> np.ndarray:
-        """Predict on raw features (binned through the train mappers)."""
+                pred_leaf: bool = False, **overrides) -> np.ndarray:
+        """Predict on raw features (binned through the train mappers).
+
+        ``overrides``: per-call serving-knob overrides (upstream's
+        predict-kwargs-as-params convention) — ``tpu_predict_
+        parallel_trees`` / ``tpu_predict_buckets`` /
+        ``tpu_predict_chunk_rows`` tune one call without mutating the
+        engine config."""
         if self.linear_tree:
             # linear leaves need raw feature values — host-model path
             # (cached; the model list only grows)
             from ..io.model_text import HostModel
+            hm_key = (len(self.models), self._models_version)
             cache = getattr(self, "_hm_cache", (None, None))
-            if cache[0] != len(self.models):
-                cache = (len(self.models),
+            if cache[0] != hm_key:
+                cache = (hm_key,
                          HostModel.from_engine(self, self.config))
                 self._hm_cache = cache
             return cache[1].predict(X, raw_score=raw_score,
@@ -2257,27 +2340,146 @@ class GBDT:
         num_iteration = min(num_iteration, total_iters - start_iteration)
         n_trees = num_iteration * self.num_class
         start_tree = start_iteration * self.num_class
-        n = X.shape[0]
+        n = n_rows
         if n_trees <= 0:
-            raw = np.tile(self.init_scores, (n, 1))
-        else:
-            stacked, class_idx = self._stack_models(start_tree, n_trees)
-            raw_dev, leaves = forest_predict_binned(
-                stacked, jnp.asarray(bins), self.feat_num_bin,
-                self.feat_has_nan, class_idx, self.num_class)
             if pred_leaf:
-                return np.asarray(leaves).T.astype(np.int32)
-            raw = np.asarray(raw_dev, dtype=np.float64)
+                return np.zeros((n, 0), dtype=np.int32)
+            raw = np.tile(self.init_scores, (n, 1))
+            if raw_score:
+                return raw[:, 0] if self.num_class == 1 else raw
+            return self._convert_output_np(raw)
+
+        def post(raw_np: np.ndarray) -> np.ndarray:
+            # per-chunk post-processing on the still-PADDED rows (all
+            # steps are row-local, so padded rows never affect real
+            # ones, and the convert step's jit sees only the bounded
+            # bucket/chunk shapes — not one shape per request size)
             if self.average_output:
                 # RF: trees carry the init-score bias; average them
-                raw = raw / num_iteration
+                raw_np = raw_np / num_iteration
             elif start_iteration == 0:
-                raw = raw + self.init_scores[None, :]
+                raw_np = raw_np + self.init_scores[None, :]
+            if raw_score:
+                return raw_np[:, 0] if self.num_class == 1 else raw_np
+            return self._convert_output_np(raw_np)
+
+        from ..config import coerce_bool
+        use_cache = (coerce_bool(overrides["tpu_predict_cache"])
+                     if "tpu_predict_cache" in overrides else None)
+        stacked, class_idx = self._stack_for_predict(
+            start_tree, n_trees, use_cache=use_cache)
+        out, leaves = self._run_forest_chunks(
+            stacked, class_idx, bins, n_trees, want_leaves=pred_leaf,
+            # pred_leaf discards raw scores: skip their copy + convert
+            postprocess=None if pred_leaf else post, overrides=overrides)
         if pred_leaf:
-            return np.zeros((n, 0), dtype=np.int32)
-        if raw_score:
-            return raw[:, 0] if self.num_class == 1 else raw
-        return self._convert_output_np(raw)
+            return leaves.T.astype(np.int32)
+        return out
+
+    # ------------------------------------------------------------------
+    def _stack_for_predict(self, start_tree: int, n_trees: int,
+                           use_cache=None):
+        """Stack the requested tree range with shape-stabilizing
+        padding. The full forest stacks exactly (the serving steady
+        state — one stacked shape per model size, and the same shape
+        the score-rebuild/valid-eval paths already compiled). Partial
+        ranges — ``num_iteration``/``start_iteration`` early-stop
+        serving — pad the tree count to the next power of two and every
+        tree to the config leaf cap, so each distinct slice length
+        reuses a bucketed traversal compile instead of triggering a
+        fresh one (the same ``pad_count``/``pad_leaves`` knobs DART's
+        drop stacks use)."""
+        if start_tree == 0 and n_trees == len(self.models):
+            return self._stack_model_list(list(range(n_trees)),
+                                          use_cache=use_cache)
+        return self._stack_model_list(
+            list(range(start_tree, start_tree + n_trees)),
+            pad_count=_next_pow2(n_trees),
+            pad_leaves=self.config.num_leaves, use_cache=use_cache)
+
+    def _run_forest_chunks(self, stacked, class_idx, bins, n_trees: int,
+                           want_leaves: bool = False, postprocess=None,
+                           overrides=None):
+        """Traverse the stacked forest over host-binned rows with
+        batch-shape bucketing and chunked double-buffered streaming.
+
+        Small batches pad up to power-of-two row buckets (bounded
+        compile cache under arbitrary request sizes); jobs larger than
+        ``tpu_predict_chunk_rows`` stream in fixed-size chunks — every
+        chunk the SAME shape — with ``copy_to_host_async`` issued
+        before the next chunk's dispatch so device compute and the
+        device->host copy overlap (the dispatch-latency lesson
+        docs/perf.md records for training). ``postprocess`` (row-local:
+        score averaging / init-score add / output convert) runs per
+        chunk while rows are still padded, so its jit also sees only
+        bucket shapes. Padded rows are sliced off before returning;
+        real-row outputs are identical to one unpadded pass.
+
+        Returns (per-row output ``[n, ...]`` f64,
+                 leaf indices ``[n_trees, n]`` int32 or None).
+        """
+        from ..config import coerce_bool
+        cfg = self.config
+
+        def knob(name, cast):
+            if overrides and name in overrides:
+                return cast(overrides[name])
+            return cast(getattr(cfg, name))
+
+        n_rows = bins.shape[0]
+        mode = (None if knob("tpu_predict_parallel_trees", coerce_bool)
+                else "scan")
+        chunk = max(knob("tpu_predict_chunk_rows", int), 1024)
+        if n_rows <= chunk:
+            pad_to = (_predict_row_bucket(n_rows, chunk)
+                      if knob("tpu_predict_buckets", coerce_bool)
+                      else n_rows)
+            plan = [(0, n_rows, pad_to)]
+        else:
+            plan = [(s, min(chunk, n_rows - s), chunk)
+                    for s in range(0, n_rows, chunk)]
+
+        raw_parts: List[np.ndarray] = []
+        leaf_parts: List[np.ndarray] = []
+
+        def drain(item):
+            raw_dev, leaves_dev, rows = item
+            if raw_dev is not None:
+                raw_np = np.asarray(raw_dev, dtype=np.float64)
+                if postprocess is not None:
+                    raw_np = postprocess(raw_np)
+                raw_parts.append(raw_np[:rows])
+            if leaves_dev is not None:
+                leaf_parts.append(np.asarray(leaves_dev)[:, :rows])
+
+        pending: List[tuple] = []
+        for start, rows, pad_to in plan:
+            blk = bins[start:start + rows]
+            if pad_to > rows:
+                blk = np.concatenate(
+                    [blk, np.zeros((pad_to - rows, blk.shape[1]),
+                                   blk.dtype)])
+            raw_dev, leaves_dev = forest_predict_binned(
+                stacked, jnp.asarray(blk), self.feat_num_bin,
+                self.feat_has_nan, class_idx, self.num_class, mode=mode)
+            if want_leaves:
+                # leaf-only request: the raw scores are never read back
+                leaves_dev.copy_to_host_async()
+                pending.append((None, leaves_dev, rows))
+            else:
+                raw_dev.copy_to_host_async()
+                pending.append((raw_dev, None, rows))
+            if len(pending) >= 2:   # double buffer: block on the oldest
+                drain(pending.pop(0))
+        while pending:
+            drain(pending.pop(0))
+        if want_leaves:
+            leaves = (leaf_parts[0] if len(leaf_parts) == 1
+                      else np.concatenate(leaf_parts, axis=1))[:n_trees]
+            return None, leaves
+        raw = (raw_parts[0] if len(raw_parts) == 1
+               else np.concatenate(raw_parts, axis=0))
+        return raw, None
 
     @property
     def current_iteration(self) -> int:

@@ -98,6 +98,9 @@ class RandomForest(GBDT):
             bias = float(self.init_scores[c])
             t.leaf_value = t.leaf_value + bias
             t.internal_value = t.internal_value + bias
+        # the bias fold mutated the just-appended trees: drop any stack
+        # cached between the append and here
+        self._invalidate_forest_cache()
 
         self._pred_sum = self._pred_sum + pred + self._s0
         self.score = self._base + self._pred_sum / n

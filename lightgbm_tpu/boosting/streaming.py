@@ -605,7 +605,9 @@ class StreamingGBDT:
     # ------------------------------------------------------- predict
     def predict(self, X, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
-                pred_leaf: bool = False) -> np.ndarray:
+                pred_leaf: bool = False, **_overrides) -> np.ndarray:
+        # _overrides: tpu_predict_* serving knobs (resident-engine
+        # traversal only; the host-model path here ignores them)
         from ..io.model_text import HostModel
         cache = getattr(self, "_hm_cache", (None, None))
         if cache[0] != len(self.models):

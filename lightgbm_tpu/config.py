@@ -324,6 +324,27 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # TensorBoard / xprof) — the §5 tracing subsystem; the reference's
     # analog is the global function timers + GPU_DEBUG timing
     "tpu_profile_dir": _P("str", ""),
+    # ---- serving fast path (ops/predict.py + GBDT.predict) -----------
+    # level-synchronous tree-parallel forest traversal: all T trees
+    # advance one level per step as one batched MXU contraction (or a
+    # batched gather off-TPU / for very wide trees) instead of a
+    # per-tree lax.scan — O(max_depth) steps instead of O(T*depth).
+    # false = the legacy per-tree scan (bit-identical outputs either
+    # way; tests/test_predict_engine.py pins it)
+    "tpu_predict_parallel_trees": _P("bool", True),
+    # pad predict batches up to power-of-two row buckets so arbitrary
+    # request sizes hit a BOUNDED traversal compile cache; padded rows
+    # are dropped before returning (results unchanged)
+    "tpu_predict_buckets": _P("bool", True),
+    # rows per device chunk for large scoring jobs: bigger requests
+    # stream in fixed-size chunks (one compiled shape) with
+    # double-buffered async device->host copies
+    "tpu_predict_chunk_rows": _P("int", 65536, [], (1024, None)),
+    # stacked-forest device cache: memoize contiguous tree-range stacks
+    # on the engine so repeat predict calls on an unchanged model skip
+    # host re-stacking and HBM re-upload entirely (invalidated on any
+    # model mutation)
+    "tpu_predict_cache": _P("bool", True),
     # leaf-histogram storage: "pool" keeps the [L+1, F, B, 3] carry and
     # derives siblings by subtraction (the reference's HistogramPool);
     # "rebuild" computes BOTH children per round in one scan — the masks

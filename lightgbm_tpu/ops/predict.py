@@ -7,15 +7,43 @@ SURVEY.md banner): per-row node walk by threshold comparisons.
 TPU-first: all rows traverse in lockstep — a ``while_loop`` over tree
 depth where each step gathers (feature, threshold, children) for every
 row's current node and advances; rows that reached a leaf (negative node
-encoding) freeze. Trees stack along a leading axis and are folded with
-``lax.scan``, so predicting a whole model is one jitted program.
+encoding) freeze.
+
+Two forest formulations share the per-level step logic:
+
+- ``mode="scan"`` (the original, kept as the reference path): trees fold
+  sequentially with ``lax.scan`` — O(T·depth) small steps.
+- ``mode="level"`` (default, the serving fast path): LEVEL-SYNCHRONOUS
+  tree-parallel traversal — a ``[T, n]`` node-state advances every tree
+  one level per step, so the program runs O(max_depth) steps of large
+  batched contractions instead of O(T·depth) small ones. On TPU the step
+  is a batched ``[T, n, Ln] x [T, Ln, C]`` MXU matmul (the node
+  attributes packed exactly as in the per-tree formulation); off-TPU and
+  for trees wider than ``ONEHOT_MAX_NODES`` it is a batched gather (the
+  same O(n)-memory fallback the per-tree path uses).
+
+Both modes produce bit-identical outputs: each tree's per-row leaf value
+is exact under either formulation (the one-hot contraction selects a
+single element at HIGHEST precision), and the per-class score
+accumulation replays the reference scan's sequential tree order.
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
+import functools
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# Widest per-tree node table the one-hot matmul formulation handles;
+# wider trees use the O(n)-memory gather formulation (same cutoff as the
+# score update in boosting/gbdt.py).
+ONEHOT_MAX_NODES = 512
+
+# Peak per-level one-hot operand budget (elements) for the tree-parallel
+# step: trees scan in power-of-two blocks so T_blk * n * max(Ln, F)
+# stays bounded (~1 GiB f32 for the [T, n, Ln] membership one-hot).
+LEVEL_ONEHOT_BUDGET = 256 * 1024 * 1024
 
 
 def tree_predict_binned(tree: Dict[str, jax.Array], bins: jax.Array,
@@ -49,7 +77,7 @@ def tree_predict_binned(tree: Dict[str, jax.Array], bins: jax.Array,
     # update in boosting/gbdt.py).
     sf = tree["split_feature"].astype(jnp.int32)
     Ln = sf.shape[0]
-    if Ln > 512:
+    if Ln > ONEHOT_MAX_NODES:
         return _tree_predict_binned_gather(tree, bins, feat_num_bin,
                                            feat_has_nan, node0)
     node_nan_bin = jnp.where(feat_has_nan[sf],
@@ -151,21 +179,193 @@ def _tree_predict_binned_gather(tree, bins, feat_num_bin, feat_has_nan,
     return tree["leaf_value"][leaf], leaf
 
 
-def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
-                          feat_num_bin: jax.Array, feat_has_nan: jax.Array,
-                          class_index: jax.Array,
-                          num_class: int) -> Tuple[jax.Array, jax.Array]:
-    """Sum leaf outputs of a stacked forest into per-class raw scores.
+# ---------------------------------------------------------------------------
+# Level-synchronous tree-parallel traversal (the serving fast path)
+# ---------------------------------------------------------------------------
 
-    Args:
-      stacked: tree arrays with a leading ``[T]`` axis (trees padded to a
-        common ``num_leaves`` capacity).
-      class_index: ``[T]`` int32 — class each tree contributes to
-        (``t % num_class`` for multiclass round-robin, zeros for K=1).
+def _level_traverse(stacked, bins, feat_num_bin, feat_has_nan,
+                    formulation):
+    """Advance ALL T trees one level per step.
 
-    Returns:
-      (raw scores ``[n, num_class]``, leaf indices ``[T, n]``)
+    Returns (leaf value per (tree, row) ``[T, n]`` f32,
+             leaf index per (tree, row) ``[T, n]`` int32).
     """
+    sf = stacked["split_feature"].astype(jnp.int32)      # [T, Ln]
+    T, Ln = sf.shape
+    n, F = bins.shape
+    node0 = jnp.where(stacked["num_leaves"][:, None] > 1,
+                      jnp.zeros((T, n), jnp.int32),
+                      jnp.full((T, n), -1, jnp.int32))
+    has_cat = "is_cat" in stacked
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    if formulation == "onehot":
+        # batched variant of the per-tree packed-attribute matmul: one
+        # [T, n, Ln] x [T, Ln, C] contraction per level (batch dim = T)
+        node_nan_bin = jnp.where(feat_has_nan[sf],
+                                 feat_num_bin[sf] - 1, -1)   # [T, Ln]
+        attr_cols = [sf.astype(jnp.float32),
+                     stacked["threshold_bin"].astype(jnp.float32),
+                     stacked["default_left"].astype(jnp.float32),
+                     node_nan_bin.astype(jnp.float32),
+                     stacked["left_child"].astype(jnp.float32),
+                     stacked["right_child"].astype(jnp.float32)]
+        if has_cat:
+            bs = stacked["cat_bitset"]                   # [T, Ln, W]
+            W = bs.shape[2]
+            attr_cols.append(stacked["is_cat"].astype(jnp.float32))
+            attr_cols.extend(jnp.moveaxis(
+                (bs & jnp.uint32(0xFFFF)).astype(jnp.float32), 2, 0))
+            attr_cols.extend(jnp.moveaxis(
+                (bs >> jnp.uint32(16)).astype(jnp.float32), 2, 0))
+        packed = jnp.stack(attr_cols, axis=2)            # [T, Ln, C]
+        node_ids = jnp.arange(Ln, dtype=jnp.int32)
+        col_ids = jnp.arange(F, dtype=jnp.int32)
+        bins_i = bins.astype(jnp.int32)
+
+        def body(node):
+            nd = jnp.maximum(node, 0)                    # [T, n]
+            oh = (nd[:, :, None] == node_ids).astype(jnp.float32)
+            attr = jax.lax.dot_general(                  # [T, n, C]
+                oh, packed,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                precision=jax.lax.Precision.HIGHEST)
+            feat_r = attr[..., 0].astype(jnp.int32)
+            thr_r = attr[..., 1].astype(jnp.int32)
+            dl_r = attr[..., 2] > 0.5
+            nan_r = attr[..., 3].astype(jnp.int32)
+            oh_f = feat_r[:, :, None] == col_ids         # [T, n, F]
+            col = jnp.sum(jnp.where(oh_f, bins_i[None], 0), axis=2)
+            go_left = jnp.where(col == nan_r, dl_r, col <= thr_r)
+            if has_cat:
+                W = stacked["cat_bitset"].shape[2]
+                oh_w = ((col >> 5)[..., None]
+                        == jnp.arange(W, dtype=jnp.int32))
+                lo16 = jnp.sum(
+                    jnp.where(oh_w, attr[..., 7:7 + W], 0.0),
+                    axis=2).astype(jnp.uint32)
+                hi16 = jnp.sum(
+                    jnp.where(oh_w, attr[..., 7 + W:7 + 2 * W], 0.0),
+                    axis=2).astype(jnp.uint32)
+                word = lo16 | (hi16 << jnp.uint32(16))
+                cat_left = ((word >> (col & 31).astype(jnp.uint32))
+                            & jnp.uint32(1)) > 0
+                go_left = jnp.where(attr[..., 6] > 0.5, cat_left,
+                                    go_left)
+            nxt = jnp.where(go_left, attr[..., 4], attr[..., 5]) \
+                .astype(jnp.int32)
+            return jnp.where(node >= 0, nxt, node)
+
+        node = jax.lax.while_loop(cond, body, node0)
+        leaf = (-node - 1).astype(jnp.int32)
+        L = stacked["leaf_value"].shape[1]
+        oh_leaf = (leaf[..., None]
+                   == jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
+        vals = jax.lax.dot_general(
+            oh_leaf, stacked["leaf_value"][..., None],
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST)[..., 0]
+        return vals, leaf
+
+    # gather formulation: batched per-(tree, row) table lookups — the
+    # O(n)-memory path (CPU backend, or trees wider than the one-hot
+    # cutoff; identical routing math, identical results)
+    thr_t = stacked["threshold_bin"].astype(jnp.int32)
+    dl_t = stacked["default_left"]
+    lc_t = stacked["left_child"].astype(jnp.int32)
+    rc_t = stacked["right_child"].astype(jnp.int32)
+
+    def take(a, idx):                                    # [T, Ln] x [T, n]
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    def body(node):
+        nd = jnp.maximum(node, 0)                        # [T, n]
+        feat = take(sf, nd)
+        thr = take(thr_t, nd)
+        dleft = take(dl_t, nd)
+        col = jax.vmap(lambda f: jnp.take_along_axis(
+            bins, f[:, None], axis=1)[:, 0])(feat).astype(jnp.int32)
+        missing = feat_has_nan[feat] & (col == feat_num_bin[feat] - 1)
+        go_left = jnp.where(missing, dleft, col <= thr)
+        if has_cat:
+            bitset = jnp.take_along_axis(
+                stacked["cat_bitset"], nd[..., None], axis=1)  # [T,n,W]
+            word = jnp.take_along_axis(
+                bitset, (col >> 5)[..., None], axis=2)[..., 0]
+            cat_left = ((word >> (col & 31).astype(jnp.uint32))
+                        & jnp.uint32(1)) > 0
+            go_left = jnp.where(take(stacked["is_cat"], nd), cat_left,
+                                go_left)
+        nxt = jnp.where(go_left, take(lc_t, nd), take(rc_t, nd))
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node0)
+    leaf = (-node - 1).astype(jnp.int32)
+    vals = jnp.take_along_axis(stacked["leaf_value"], leaf, axis=1)
+    return vals, leaf
+
+
+def default_formulation(num_nodes: int) -> str:
+    """Backend-appropriate level-step formulation: the batched one-hot
+    matmul on TPU (gathers are scalar-unit poison, docs/perf.md), the
+    batched gather elsewhere and for trees wider than the one-hot
+    cutoff."""
+    return ("onehot" if num_nodes <= ONEHOT_MAX_NODES
+            and jax.default_backend() == "tpu" else "gather")
+
+
+def _forest_traverse(stacked, bins, feat_num_bin, feat_has_nan,
+                     formulation):
+    """Level-synchronous traversal with the one-hot operand bounded:
+    when T * n * max(Ln, F) would exceed LEVEL_ONEHOT_BUDGET, trees
+    scan in equal power-of-two blocks, each block level-synchronous."""
+    T, Ln = stacked["split_feature"].shape
+    n, F = bins.shape
+    if formulation == "onehot":
+        width = max(Ln, F, 1)
+        cap = max(LEVEL_ONEHOT_BUDGET // max(n * width, 1), 1)
+        if T > cap:
+            # largest divisor of T within budget (lax.scan needs equal
+            # blocks; worst case tb=1 degrades to a per-tree scan,
+            # never an unbounded operand)
+            tb = next(d for d in range(min(cap, T), 0, -1) if T % d == 0)
+        else:
+            tb = T
+        if tb < T:
+            blocks = jax.tree.map(
+                lambda a: a.reshape((T // tb, tb) + a.shape[1:]),
+                stacked)
+
+            def blk(carry, s):
+                return carry, _level_traverse(
+                    s, bins, feat_num_bin, feat_has_nan, "onehot")
+
+            _, (vals, leaf) = jax.lax.scan(blk, None, blocks)
+            return vals.reshape(T, n), leaf.reshape(T, n)
+    return _level_traverse(stacked, bins, feat_num_bin, feat_has_nan,
+                           formulation)
+
+
+def _class_accumulate(vals, class_index, num_class):
+    """Per-class score sums in the EXACT sequential tree order the
+    reference per-tree scan used (f32 addition is order-sensitive; this
+    keeps mode="level" bit-identical to mode="scan")."""
+    n = vals.shape[1]
+
+    def body(carry, xs):
+        v, cls = xs
+        return carry.at[:, cls].add(v), None
+
+    init = jnp.zeros((n, num_class), jnp.float32)
+    scores, _ = jax.lax.scan(body, init, (vals, class_index))
+    return scores
+
+
+def _forest_predict_scan(stacked, bins, feat_num_bin, feat_has_nan,
+                         class_index, num_class):
+    """The original per-tree lax.scan fold (reference traversal order)."""
     n = bins.shape[0]
 
     def body(carry, xs):
@@ -177,3 +377,59 @@ def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
     init = jnp.zeros((n, num_class), jnp.float32)
     scores, leaves = jax.lax.scan(body, init, (stacked, class_index))
     return scores, leaves
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_class", "mode", "formulation"))
+def _forest_predict_impl(stacked, bins, feat_num_bin, feat_has_nan,
+                         class_index, num_class, mode, formulation):
+    if mode == "scan":
+        return _forest_predict_scan(stacked, bins, feat_num_bin,
+                                    feat_has_nan, class_index, num_class)
+    vals, leaves = _forest_traverse(stacked, bins, feat_num_bin,
+                                    feat_has_nan, formulation)
+    return _class_accumulate(vals, class_index, num_class), leaves
+
+
+def predict_program_cache_size() -> int:
+    """Number of distinct compiled forest-predict programs this process
+    holds — the quantity the batch-shape bucketing bounds (tests pin it
+    via utils/debug.py)."""
+    return _forest_predict_impl._cache_size()
+
+
+def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
+                          feat_num_bin: jax.Array, feat_has_nan: jax.Array,
+                          class_index: jax.Array,
+                          num_class: int,
+                          mode: Optional[str] = None,
+                          formulation: Optional[str] = None
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Sum leaf outputs of a stacked forest into per-class raw scores.
+
+    Args:
+      stacked: tree arrays with a leading ``[T]`` axis (trees padded to a
+        common ``num_leaves`` capacity).
+      class_index: ``[T]`` int32 — class each tree contributes to
+        (``t % num_class`` for multiclass round-robin, zeros for K=1).
+      mode: "level" (default; tree-parallel level-synchronous) or
+        "scan" (the original per-tree fold — kept as the reference path
+        and the ``tpu_predict_parallel_trees=false`` escape hatch).
+      formulation: level-step kind, "onehot" | "gather"; None picks by
+        backend and tree width (``default_formulation``).
+
+    Returns:
+      (raw scores ``[n, num_class]``, leaf indices ``[T, n]``)
+
+    The whole program is jitted; its compile cache is keyed on the
+    operand shapes, which the engine keeps bounded via stacked-forest
+    padding and batch-shape bucketing (boosting/gbdt.py::predict).
+    """
+    if mode is None or mode == "auto":
+        mode = "level"
+    if mode == "scan":
+        formulation = None
+    elif formulation is None:
+        formulation = default_formulation(stacked["split_feature"].shape[1])
+    return _forest_predict_impl(stacked, bins, feat_num_bin, feat_has_nan,
+                                class_index, num_class, mode, formulation)

@@ -438,7 +438,12 @@ def forest_shap_batch(trees, X, n_feat, K=1, row_chunk=131072,
     import contextlib
     ctx = contextlib.ExitStack()
     if force_f64:
-        ctx.enter_context(jax.enable_x64())
+        # jax.enable_x64 only exists on newer jax; the pinned runtime
+        # ships it under jax.experimental
+        x64_ctx = getattr(jax, "enable_x64", None)
+        if x64_ctx is None:
+            from jax.experimental import enable_x64 as x64_ctx
+        ctx.enter_context(x64_ctx())
         if jax.default_backend() != "cpu":
             ctx.enter_context(
                 jax.default_device(jax.devices("cpu")[0]))

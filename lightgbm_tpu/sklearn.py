@@ -180,6 +180,11 @@ class LGBMModel(_SKBase):
                 num_iteration: Optional[int] = None,
                 pred_leaf: bool = False, pred_contrib: bool = False,
                 **kwargs) -> np.ndarray:
+        """Predict (serving fast path: tree-parallel traversal, cached
+        device forest, batch-shape bucketing). Extra ``kwargs`` follow
+        the upstream predict-params convention — e.g.
+        ``tpu_predict_chunk_rows=8192`` tunes one call's streaming
+        chunk size without touching the fitted model's params."""
         return self.booster_.predict(
             X, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,

@@ -1,0 +1,77 @@
+"""Test/diagnostic instrumentation: XLA compile counting.
+
+The serving guarantees are pinned by tests, not just measured: batch-
+shape bucketing promises a BOUNDED compile cache under arbitrary
+request sizes, and the stacked-forest cache promises zero re-stack /
+re-upload on repeat predicts. This module gives tests the two probes
+those assertions need:
+
+- :class:`CompileWatch` — counts XLA compile requests between enter and
+  exit via ``jax.monitoring`` events. A jit cache hit records nothing;
+  every fresh trace->lower->compile records at least one event, so
+  ``watch.compiles == 0`` is exactly "no new program was built" (a
+  persistent-compilation-cache hit still counts as a compile request —
+  it is a jit cache miss, which is what bucketing bounds).
+- :func:`predict_program_cache_size` — the number of distinct compiled
+  forest-traversal programs (re-exported from ops/predict.py).
+"""
+from __future__ import annotations
+
+from typing import List
+
+# any event under this prefix marks one compile request reaching the
+# compilation-cache layer (observed: one fresh jit compile fires 1-3 of
+# them; a jit cache hit fires none)
+_COMPILE_EVENT_PREFIX = "/jax/compilation_cache/compile_requests"
+
+
+class CompileWatch:
+    """Context manager counting XLA compile requests.
+
+    >>> with CompileWatch() as w:
+    ...     booster.predict(X)
+    >>> assert w.compiles == 0   # warm path: no fresh programs
+
+    ``compiles`` is the number of compile-request events seen — compare
+    against zero (exact) or use as an upper-bound proxy; one logical
+    compile can fire a small handful of events, so assert ``== 0`` or
+    ``<= bound`` with slack, never an exact nonzero count.
+    """
+
+    def __init__(self) -> None:
+        self.compiles = 0
+        self.events: List[str] = []
+        self._active = False
+
+    def _listener(self, event: str, **kwargs) -> None:
+        if not self._active:
+            return
+        self.events.append(event)
+        if event.startswith(_COMPILE_EVENT_PREFIX):
+            self.compiles += 1
+
+    def __enter__(self) -> "CompileWatch":
+        from jax import monitoring
+        monitoring.register_event_listener(self._listener)
+        self._active = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # stop counting FIRST: even if the unregister below fails, the
+        # listener goes inert rather than polluting later watches — and
+        # never clear_event_listeners(), which would wipe listeners we
+        # do not own
+        self._active = False
+        try:
+            # unregister lives in jax._src.monitoring on the pinned jax
+            from jax._src import monitoring as _m
+            _m._unregister_event_listener_by_callback(self._listener)
+        except Exception:
+            pass
+
+
+def predict_program_cache_size() -> int:
+    """Distinct compiled forest-traversal programs held by this process
+    (the quantity batch-shape bucketing bounds)."""
+    from ..ops.predict import predict_program_cache_size as _sz
+    return _sz()

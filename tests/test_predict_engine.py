@@ -1,0 +1,304 @@
+"""Serving-grade inference engine tests (tree-parallel traversal,
+stacked-forest caching, batch-shape bucketing — ops/predict.py +
+GBDT.predict).
+
+The contracts pinned here:
+1. the level-synchronous tree-parallel traversal is BIT-IDENTICAL to
+   the reference per-tree scan on every model family (numerical with
+   NaNs, categorical bitsets, multiclass round-robin, DART, RF
+   averaging, pred_leaf) in both level-step formulations;
+2. bucketed/padded/chunked predict == unpadded predict for ragged
+   batch sizes;
+3. repeat predicts on an unchanged model do ZERO host-side tree
+   stacking, ZERO forest re-uploads, and ZERO fresh XLA compiles
+   (the serving steady state);
+4. num_iteration/start_iteration slices share bucketed stack shapes
+   instead of compiling per slice.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.predict import (forest_predict_binned,
+                                      predict_program_cache_size)
+from lightgbm_tpu.utils.debug import CompileWatch
+
+
+def _data(n=1200, f=8, seed=0, nan_frac=0.05, n_cat=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    logit = X @ w + 0.6 * X[:, 0] * X[:, 1]
+    if nan_frac:
+        X[rng.random((n, f)) < nan_frac] = np.nan
+    cats = []
+    for c in range(n_cat):
+        cv = rng.integers(0, 10 + 6 * c, size=n)
+        logit = logit + rng.normal(size=10 + 6 * c)[cv]
+        cats.append(cv.astype(np.float64))
+    if cats:
+        X = np.column_stack([X] + cats)
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _train(params, X, y, rounds=10, cat="auto"):
+    ds = lgb.Dataset(X, label=y, categorical_feature=cat)
+    p = {"verbosity": -1, **params}
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+_FIXTURES = None
+
+
+def _fixture_boosters():
+    """One booster per model family the traversal must cover (built
+    once per test process; tests must restore any config they toggle)."""
+    global _FIXTURES
+    if _FIXTURES is not None:
+        return _FIXTURES
+    X, y = _data()
+    Xc, yc = _data(seed=3, n_cat=2)
+    rng = np.random.default_rng(5)
+    ym = rng.integers(0, 3, size=len(X)).astype(np.float64)
+    _FIXTURES = [
+        ("binary+nan", X,
+         _train({"objective": "binary", "num_leaves": 15}, X, y)),
+        ("categorical", Xc,
+         _train({"objective": "binary", "num_leaves": 15}, Xc, yc,
+                cat=[8, 9])),
+        ("multiclass", X,
+         _train({"objective": "multiclass", "num_class": 3,
+                 "num_leaves": 7}, X, ym)),
+        ("dart", X,
+         _train({"objective": "regression", "boosting": "dart",
+                 "num_leaves": 15, "drop_rate": 0.5, "skip_drop": 0.0},
+                X, y, rounds=8)),
+        ("rf", X,
+         _train({"objective": "binary", "boosting": "rf",
+                 "num_leaves": 15, "bagging_freq": 1,
+                 "bagging_fraction": 0.6}, X, y, rounds=8)),
+    ]
+    return _FIXTURES
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-exactness of the tree-parallel traversal vs the per-tree scan
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("formulation", ["gather", "onehot"])
+def test_level_sync_bit_identical_to_scan(formulation):
+    import jax.numpy as jnp
+    for name, X, bst in _fixture_boosters():
+        eng = bst.engine
+        stacked, ci = eng._stack_models(0, len(eng.models))
+        bins = jnp.asarray(eng.train_set._bin_all_columns(
+            lgb.Dataset._to_matrix(X), False, eng.train_set.binned.dtype,
+            n_rows=len(X)))
+        s0, l0 = forest_predict_binned(
+            stacked, bins, eng.feat_num_bin, eng.feat_has_nan, ci,
+            eng.num_class, mode="scan")
+        s1, l1 = forest_predict_binned(
+            stacked, bins, eng.feat_num_bin, eng.feat_has_nan, ci,
+            eng.num_class, mode="level", formulation=formulation)
+        assert np.array_equal(np.asarray(l0), np.asarray(l1)), \
+            f"{name}: leaf routing diverged ({formulation})"
+        assert np.array_equal(np.asarray(s0), np.asarray(s1)), \
+            f"{name}: scores diverged ({formulation})"
+
+
+def test_booster_predict_level_vs_scan_end_to_end():
+    """Full predict() pipeline equality, incl. pred_leaf, under the
+    tpu_predict_parallel_trees escape hatch."""
+    for name, X, bst in _fixture_boosters():
+        eng = bst.engine
+        p1 = bst.predict(X)
+        l1 = bst.predict(X, pred_leaf=True)
+        eng.config.tpu_predict_parallel_trees = False
+        p0 = bst.predict(X)
+        l0 = bst.predict(X, pred_leaf=True)
+        eng.config.tpu_predict_parallel_trees = True
+        assert np.array_equal(p0, p1), name
+        assert np.array_equal(l0, l1), name
+
+
+# ---------------------------------------------------------------------------
+# 2. bucketing / padding / chunking never changes results
+# ---------------------------------------------------------------------------
+
+def test_bucketed_predict_equals_unpadded():
+    X, y = _data(n=2100)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    eng = bst.engine
+    for n in (1, 2, 5, 127, 128, 129, 1000, 2100):
+        padded = bst.predict(X[:n], raw_score=True)
+        eng.config.tpu_predict_buckets = False
+        exact = bst.predict(X[:n], raw_score=True)
+        eng.config.tpu_predict_buckets = True
+        assert padded.shape[0] == n
+        assert np.array_equal(padded, exact), n
+
+
+def test_chunked_predict_equals_single_pass():
+    X, y = _data(n=3000)
+    bst = _train({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 7}, X,
+                 np.random.default_rng(1).integers(
+                     0, 3, size=3000).astype(np.float64))
+    eng = bst.engine
+    eng.config.tpu_predict_chunk_rows = 1024   # 3 chunks, last padded
+    chunked = bst.predict(X)
+    chunked_leaf = bst.predict(X, pred_leaf=True)
+    eng.config.tpu_predict_chunk_rows = 65536
+    single = bst.predict(X)
+    single_leaf = bst.predict(X, pred_leaf=True)
+    assert np.array_equal(chunked, single)
+    assert np.array_equal(chunked_leaf, single_leaf)
+
+
+def test_num_iteration_slices_match_legacy():
+    X, y = _data(n=900)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y,
+                 rounds=12)
+    eng = bst.engine
+    for start, num in ((0, 3), (2, 5), (5, -1), (0, 12)):
+        a = bst.predict(X, raw_score=True, start_iteration=start,
+                        num_iteration=num)
+        eng.config.tpu_predict_parallel_trees = False
+        eng.config.tpu_predict_buckets = False
+        b = bst.predict(X, raw_score=True, start_iteration=start,
+                        num_iteration=num)
+        eng.config.tpu_predict_parallel_trees = True
+        eng.config.tpu_predict_buckets = True
+        assert np.array_equal(a, b), (start, num)
+
+
+# ---------------------------------------------------------------------------
+# 3. the serving steady state: zero stacking / uploads / compiles
+# ---------------------------------------------------------------------------
+
+def test_second_predict_zero_stacking_and_zero_compiles():
+    X, y = _data(n=800)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    eng = bst.engine
+
+    bst.predict(X[:500])               # warms stack + the 512 bucket
+    s1, c1 = eng._stack_for_predict(0, len(eng.models))
+    s2, c2 = eng._stack_for_predict(0, len(eng.models))
+    assert s1 is s2 and c1 is c2       # device stack reused, not rebuilt
+
+    builds_before = eng._stack_builds
+    with CompileWatch() as watch:
+        p1 = bst.predict(X[:500])
+        p2 = bst.predict(X[:500])
+    # warm serving steady state: zero host-side tree stacking (and thus
+    # zero forest re-uploads — upload happens inside the build), zero
+    # fresh XLA programs
+    assert eng._stack_builds == builds_before
+    s3, _ = eng._stack_for_predict(0, len(eng.models))
+    assert s3 is s1
+    assert watch.compiles == 0, watch.events
+    assert np.array_equal(p1, p2)
+
+
+def test_model_growth_invalidates_stack_cache():
+    X, y = _data(n=600)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=5,
+                    keep_training_booster=True)
+    eng = bst.engine
+    # num_iteration=-1: train() pins best_iteration, which would
+    # otherwise clamp the post-update predict back to 5 iterations
+    p5 = bst.predict(X[:100], num_iteration=-1)
+    s5, _ = eng._stack_for_predict(0, len(eng.models))
+    bst.update()                       # model grew by one iteration
+    s6, _ = eng._stack_for_predict(0, len(eng.models))
+    assert s6 is not s5
+    p6 = bst.predict(X[:100], num_iteration=-1)
+    assert not np.array_equal(p5, p6)  # new tree actually contributes
+
+
+def test_dart_rescale_invalidates_stack_cache():
+    """DART mutates stored trees in place (shrink) without changing the
+    model count — the version bump must drop cached stacks."""
+    X, y = _data(n=600)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "boosting": "dart",
+                     "num_leaves": 15, "drop_rate": 0.9, "skip_drop": 0.0,
+                     "verbosity": -1}, ds, num_boost_round=3,
+                    keep_training_booster=True)
+    eng = bst.engine
+    ver0 = eng._models_version
+    bst.update()
+    assert eng._models_version > ver0
+    # predictions after the update must match a fresh host-side stack
+    eng.config.tpu_predict_cache = False
+    fresh = bst.predict(X[:100], raw_score=True)
+    eng.config.tpu_predict_cache = True
+    cached = bst.predict(X[:100], raw_score=True)
+    assert np.array_equal(fresh, cached)
+
+
+def test_bounded_compiles_across_ragged_sizes():
+    """The bucketing guarantee, pinned: after warming the row buckets,
+    predicts at ANY size covered by those buckets compile nothing."""
+    X, y = _data(n=2000)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    before = predict_program_cache_size()
+    for n in (128, 256, 512, 1024, 2000):   # warm each bucket once
+        bst.predict(X[:n])
+    grew = predict_program_cache_size() - before
+    assert grew <= 5
+    with CompileWatch() as watch:
+        for n in (1, 3, 60, 130, 300, 700, 1025, 1999):
+            bst.predict(X[:n])
+    assert watch.compiles == 0, watch.events
+    assert predict_program_cache_size() - before == grew
+
+
+def test_early_stop_slices_share_bucketed_shapes():
+    """num_iteration slices pad the stack to power-of-two tree counts:
+    distinct slice lengths in the same bucket reuse one compiled
+    traversal (early-stop serving must not compile per slice)."""
+    X, y = _data(n=500)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y,
+                 rounds=16)
+    eng = bst.engine
+    s5, _ = eng._stack_for_predict(0, 5)
+    s7, _ = eng._stack_for_predict(0, 7)
+    assert all(s5[k].shape == s7[k].shape for k in s5)   # same bucket (8)
+    bst.predict(X[:256], num_iteration=5)                # warm bucket
+    with CompileWatch() as watch:
+        bst.predict(X[:256], num_iteration=6)
+        bst.predict(X[:256], num_iteration=7)
+        bst.predict(X[:200], num_iteration=8)            # same buckets
+    assert watch.compiles == 0, watch.events
+
+
+# ---------------------------------------------------------------------------
+# 4. Booster host-model cache (pred_contrib / pred_early_stop serving)
+# ---------------------------------------------------------------------------
+
+def test_host_model_cached_across_pred_contrib_calls(monkeypatch):
+    from lightgbm_tpu.io.model_text import HostModel
+    X, y = _data(n=400)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=4,
+                    keep_training_booster=True)
+    builds = []
+    orig = HostModel.from_engine
+
+    def counting(*a, **k):
+        builds.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(HostModel, "from_engine", staticmethod(counting))
+    c1 = bst.predict(X[:50], pred_contrib=True)
+    c2 = bst.predict(X[:50], pred_contrib=True)
+    assert len(builds) == 1            # second call reused the cache
+    assert np.array_equal(c1, c2)
+    bst.update()                       # growth invalidates
+    bst.predict(X[:50], pred_contrib=True)
+    assert len(builds) == 2
