@@ -1,0 +1,141 @@
+"""Ingest benchmark: raw matrix -> binned matrix, host vs device.
+
+Grid: (rows, features, max_bin) cells, timing four bin-ASSIGNMENT
+paths over identical pre-built BinMappers (boundary finding is excluded
+— it is sample-sized and shared by every path):
+
+  host-loop     the serial per-column numpy fallback (native binner off)
+  host-threaded the thread-pooled per-column fallback (tpu_ingest_threads)
+  host-native   the one-pass C++ row-major binner (the pre-PR fast path)
+  device        ops/ingest.py chunked on-accelerator assignment
+                (first call = compile-inclusive; steady = cached kernel)
+
+Run:
+  python benchmarks/ingest_bench.py                      # default grid
+  python benchmarks/ingest_bench.py --rows 2000000 --features 28
+  python benchmarks/ingest_bench.py --compare            # speedup line
+                                                         # per cell
+
+Each line is one JSON record; ``--compare`` adds a
+``speedup_device_vs_best_host`` record per cell and a final aggregate.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _synth(rows, features, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, features)).astype(np.float32) \
+        .astype(np.float64)
+    if features >= 3:
+        X[:, 1] = np.where(rng.uniform(size=rows) < 0.2, 0.0, X[:, 1])
+        X[rng.uniform(size=rows) < 0.05, 2] = np.nan
+    return np.ascontiguousarray(X)
+
+
+def _dataset_for(X, y, mode, threads):
+    import lightgbm_tpu as lgb
+    dev = {"device": "true"}.get(mode, "false")
+    return lgb.Dataset(X, label=y, params={
+        "tpu_ingest_device": dev,
+        "tpu_ingest_threads": threads,
+        "verbosity": -1})
+
+
+def time_mode(X, mappers, mode, threads=0, repeats=2):
+    """Median construct-side assignment time for one path. Mapper
+    finding is done by the caller once; here the Dataset is pre-seeded
+    with those mappers so only bin ASSIGNMENT is on the clock."""
+    from lightgbm_tpu.io import binning as binning_mod
+    native_fn = binning_mod._native
+    if mode in ("host-loop", "host-threaded"):
+        binning_mod._native = lambda: None      # force the numpy path
+    try:
+        times = []
+        first_s = None
+        for r in range(repeats + (1 if mode == "device" else 0)):
+            ds = _dataset_for(X, None, mode,
+                              threads if mode == "host-threaded" else 1)
+            ds.bin_mappers = list(mappers)      # pre-seeded: construct
+            t0 = time.time()                    # keeps them verbatim
+            ds.construct()
+            ing = ds.device_ingested()
+            if ing is not None:
+                ing.bins.block_until_ready()
+            else:
+                _ = ds.binned.shape
+            dt = time.time() - t0
+            if mode == "device" and r == 0:
+                first_s = dt                    # compile-inclusive
+            else:
+                times.append(dt)
+        return sorted(times)[len(times) // 2], first_s
+    finally:
+        binning_mod._native = native_fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=str, default="200000,1000000")
+    ap.add_argument("--features", type=str, default="28")
+    ap.add_argument("--max-bin", type=str, default="255")
+    ap.add_argument("--threads", type=int, default=0,
+                    help="host-threaded pool size (0 = auto)")
+    ap.add_argument("--repeats", type=int, default=2)
+    ap.add_argument("--modes", type=str,
+                    default="host-loop,host-threaded,host-native,device")
+    ap.add_argument("--compare", action="store_true",
+                    help="print a device-vs-best-host speedup line per "
+                         "cell")
+    args = ap.parse_args()
+    from lightgbm_tpu.io.binning import find_bin_mappers
+
+    rows_list = [int(r) for r in args.rows.split(",")]
+    feat_list = [int(f) for f in args.features.split(",")]
+    mb_list = [int(b) for b in args.max_bin.split(",")]
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+
+    best_speedup = None
+    for rows in rows_list:
+        for features in feat_list:
+            X = _synth(rows, features)
+            for max_bin in mb_list:
+                mappers = find_bin_mappers(X, max_bin=max_bin)
+                cell = {}
+                for mode in modes:
+                    med, first = time_mode(X, mappers, mode,
+                                           args.threads, args.repeats)
+                    rec = {"rows": rows, "features": features,
+                           "max_bin": max_bin, "mode": mode,
+                           "assign_s": round(med, 4),
+                           "mrows_per_s": round(rows / med / 1e6, 2)}
+                    if first is not None:
+                        rec["first_call_s"] = round(first, 4)
+                    cell[mode] = med
+                    print(json.dumps(rec), flush=True)
+                if args.compare and "device" in cell:
+                    hosts = {m: t for m, t in cell.items()
+                             if m != "device"}
+                    if hosts:
+                        best_host = min(hosts, key=hosts.get)
+                        ratio = hosts[best_host] / cell["device"]
+                        best_speedup = max(best_speedup or 0.0, ratio)
+                        print(json.dumps({
+                            "rows": rows, "features": features,
+                            "max_bin": max_bin,
+                            "speedup_device_vs_best_host":
+                                round(ratio, 2),
+                            "best_host": best_host}), flush=True)
+    if args.compare and best_speedup is not None:
+        print(json.dumps({"metric": "ingest_speedup_best",
+                          "value": round(best_speedup, 2)}))
+
+
+if __name__ == "__main__":
+    main()

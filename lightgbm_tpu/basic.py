@@ -40,7 +40,18 @@ class Booster:
             train_set.params.setdefault("max_bin", self.config.max_bin)
             for key in ("min_data_in_bin", "bin_construct_sample_cnt",
                         "use_missing", "zero_as_missing",
-                        "data_random_seed", "linear_tree"):
+                        "data_random_seed", "linear_tree",
+                        # device-ingest knobs ride along so train-param
+                        # settings govern the construct that this
+                        # Booster triggers (ops/ingest.py) — including
+                        # the gates _want_transposed_ingest /
+                        # _want_device_ingest read (pallas, precision,
+                        # streaming), else construct emits device
+                        # arrays the engine will never adopt
+                        "tpu_ingest_device", "tpu_ingest_chunk_rows",
+                        "tpu_ingest_threads", "tpu_use_pallas",
+                        "tpu_double_precision_hist", "tpu_streaming",
+                        "tree_learner", "tpu_compile_cache_dir"):
                 train_set.params.setdefault(key, getattr(self.config, key))
             self._engine = create_boosting(self.config, train_set,
                                            init_forest=init_forest)

@@ -51,14 +51,15 @@ def _should_stream(config, train_set, fobj) -> bool:
     # bins) are re-checked by StreamingGBDT itself.
     if fobj is not None or not _streaming_compatible(config):
         return False
+    from ..utils.hbm import (STREAM_HBM_FRACTION, binned_device_bytes,
+                             hbm_bytes_limit)
     try:
         import jax
         if jax.device_count() > 1:
             return False        # sharded residents divide per-device
-        stats = jax.devices()[0].memory_stats() or {}
-        limit = stats.get("bytes_limit")
     except Exception:
-        limit = None
+        return False
+    limit = hbm_bytes_limit()
     if not limit:
         return False
     ds = train_set
@@ -72,8 +73,8 @@ def _should_stream(config, train_set, fobj) -> bool:
     if not n or not f:
         return False
     itemsize = 2 if int(config.max_bin) > 255 else 1
-    est = n * f * itemsize * 2        # bins + bins_t (Pallas copy)
-    if est <= 0.6 * limit:
+    est = binned_device_bytes(n, f, itemsize)   # bins + bins_t (Pallas)
+    if est <= STREAM_HBM_FRACTION * limit:
         return False
     # dataset-level gate: pandas-category / auto-detected categorical
     # bins would make StreamingGBDT fatal — keep those resident

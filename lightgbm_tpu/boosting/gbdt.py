@@ -112,20 +112,41 @@ class _DeviceData:
                 n_layout = int(g.max())
         self.n_pad = pad_rows(max(n_layout, self.n),
                               rows_per_block * row_shards)
-        binned = (ds.binned if binned_override is None
-                  else binned_override)   # EFB physical matrix
-        if n_feature_pad and binned.shape[1] < n_feature_pad:
-            # pad feature columns so every device owns an equal slice
-            # (scatter/feature-parallel); padded features never split
-            # (num_bin=1, allowed=False in the engine's metadata)
-            binned = np.concatenate(
-                [binned, np.zeros((binned.shape[0],
-                                   n_feature_pad - binned.shape[1]),
-                                  binned.dtype)], axis=1)
-        if self.n_pad > self.n:
-            pad = np.zeros((self.n_pad - self.n, binned.shape[1]),
-                           dtype=binned.dtype)
-            binned = np.concatenate([binned, pad], axis=0)
+        # device-resident ingest (ops/ingest.py): the binned matrix was
+        # PRODUCED on the accelerator — adopt it directly (row/column
+        # padding happens on device) instead of round-tripping through
+        # host. Meshes and the EFB bundled matrix keep the host upload
+        # path (sharded placement consumes host numpy).
+        dev = (ds.device_ingested() if binned_override is None else None)
+        use_dev = dev is not None and mesh is None
+        if use_dev:
+            binned = None
+            bins_width = int(dev.bins.shape[1])
+            bins_itemsize = np.dtype(dev.bins.dtype).itemsize
+        else:
+            binned = (ds.binned if binned_override is None
+                      else binned_override)   # EFB physical matrix
+            if ds.device_ingested() is not None \
+                    and getattr(ds, "_binned", None) is not None:
+                # host fallback (mesh / EFB): the host copy is now
+                # authoritative — drop the device-resident ingest
+                # arrays instead of leaving them orphaned in HBM next
+                # to the sharded uploads
+                ds._ingest = None
+            if n_feature_pad and binned.shape[1] < n_feature_pad:
+                # pad feature columns so every device owns an equal slice
+                # (scatter/feature-parallel); padded features never split
+                # (num_bin=1, allowed=False in the engine's metadata)
+                binned = np.concatenate(
+                    [binned, np.zeros((binned.shape[0],
+                                       n_feature_pad - binned.shape[1]),
+                                      binned.dtype)], axis=1)
+            if self.n_pad > self.n:
+                pad = np.zeros((self.n_pad - self.n, binned.shape[1]),
+                               dtype=binned.dtype)
+                binned = np.concatenate([binned, pad], axis=0)
+            bins_width = binned.shape[1]
+            bins_itemsize = binned.itemsize
 
         from ..parallel.mesh import P, put, shard_rows
         axis = mesh.axis_names[0] if mesh is not None else None
@@ -134,18 +155,17 @@ class _DeviceData:
         # row-major bins and (Pallas path) the feature-major bins_t;
         # per-device share divides by the row shard count. Fail with an
         # actionable message instead of an opaque device OOM.
-        try:
-            stats = jax.devices()[0].memory_stats() or {}
-            hbm_limit = stats.get("bytes_limit")
-        except Exception:            # CPU/older runtimes: no stats
-            hbm_limit = None
+        from ..utils.hbm import (ENGINE_HBM_FRACTION, binned_device_bytes,
+                                 hbm_bytes_limit)
+        hbm_limit = hbm_bytes_limit()
         if hbm_limit:
-            need = binned.nbytes * (2 if transposed else 1)
+            need = binned_device_bytes(self.n_pad, bins_width,
+                                       bins_itemsize, transposed)
             # rows (data/voting) or columns (feature-parallel) shard
             # over every mesh device either way
             n_dev = mesh.devices.size if mesh is not None else 1
             per_dev = need // n_dev
-            if per_dev > 0.92 * hbm_limit:
+            if per_dev > ENGINE_HBM_FRACTION * hbm_limit:
                 from ..utils import log as _log
                 _log.fatal(
                     f"binned data needs ~{per_dev / 2**30:.1f} GiB per "
@@ -162,20 +182,67 @@ class _DeviceData:
                 return put(mesh, np.asarray(a), P())
             return shard_rows(mesh, np.asarray(a), extra_dims)
 
-        if mesh is not None and shard_features:
-            self.bins = put(mesh, binned, P(None, axis))
+        if use_dev:
+            # no feature-column padding here: use_dev implies mesh is
+            # None, and F_pad == F without a mesh (need_fpad is a
+            # sharded-layout concern) — only rows can need padding
+            bins = dev.bins
+            assert not n_feature_pad or bins.shape[1] == n_feature_pad
+            if bins.shape[0] < self.n_pad:
+                bins = jnp.concatenate(
+                    [bins, jnp.zeros((self.n_pad - bins.shape[0],
+                                      bins.shape[1]), bins.dtype)])
+            elif bins.shape[0] > self.n_pad:
+                # a previous engine padded further (bigger block size);
+                # pad rows are zeros, so trimming is exact
+                bins = bins[:self.n_pad]
+            self.bins = bins
+            # swap the padded array back into the ingest result: the
+            # UNPADDED original's HBM is released (host_binned slices
+            # to n_rows, so Dataset consumers are unaffected) — without
+            # this the dataset would hold a second full-size copy for
+            # its whole lifetime
+            dev.bins = bins
+            self.bins_t = None
+            if transposed:
+                # feature-major int8 tile: the ingest kernel already
+                # emitted it fused with the row-major pass; derive
+                # on-device (bitcast transpose) when it did not — the
+                # HOST transpose is gone either way
+                bt = dev.bins_t
+                if bt is None:
+                    bt = jax.lax.bitcast_convert_type(
+                        bins.T.astype(jnp.uint8), jnp.int8)
+                if bt.shape[1] < self.n_pad:
+                    bt = jnp.concatenate(
+                        [bt, jnp.zeros((bt.shape[0],
+                                        self.n_pad - bt.shape[1]),
+                                       jnp.int8)], axis=1)
+                elif bt.shape[1] > self.n_pad:
+                    bt = bt[:, :self.n_pad]
+                self.bins_t = bt
+                dev.bins_t = bt
+            elif dev.bins_t is not None:
+                # this engine never reads the tile (non-Pallas config on
+                # a dataset whose construct-time params emitted it) —
+                # release its HBM instead of keeping a dead same-size
+                # copy alive via the ingest result
+                dev.bins_t = None
         else:
-            self.bins = place(binned, extra_dims=2)
-        self.bins_t = None
-        if transposed:
-            # feature-major int8 copy for the Pallas histogram kernel
-            bt = np.ascontiguousarray(binned.T).astype(np.int8)
-            if mesh is None:
-                self.bins_t = jnp.asarray(bt)
-            elif shard_features:
-                self.bins_t = put(mesh, bt, P(axis, None))
+            if mesh is not None and shard_features:
+                self.bins = put(mesh, binned, P(None, axis))
             else:
-                self.bins_t = put(mesh, bt, P(None, axis))
+                self.bins = place(binned, extra_dims=2)
+            self.bins_t = None
+            if transposed:
+                # feature-major int8 copy for the Pallas histogram kernel
+                bt = np.ascontiguousarray(binned.T).astype(np.int8)
+                if mesh is None:
+                    self.bins_t = jnp.asarray(bt)
+                elif shard_features:
+                    self.bins_t = put(mesh, bt, P(axis, None))
+                else:
+                    self.bins_t = put(mesh, bt, P(None, axis))
         self._place = place
         md = ds.metadata
 
@@ -349,7 +416,21 @@ class GBDT:
         self.bundle_plan = None
         self._bundle_dev = None
         self._bundled_binned = None
-        if config.enable_bundle and F >= 2 and not self._shard_features:
+        # under device-resident ingest the bundle probe would force a
+        # full-matrix D2H materialization (Dataset.binned) during the
+        # exact window ttfi_s exists to shrink — and dense accelerator
+        # datasets essentially never bundle. Probe only when the host
+        # copy exists anyway; tpu_ingest_device=false restores EFB.
+        _host_bins_free = (self.train_set.device_ingested() is None
+                           or getattr(self.train_set, "_binned", None)
+                           is not None)
+        if (config.enable_bundle and F >= 2 and not self._shard_features
+                and not _host_bins_free):
+            log.info("EFB bundle probe skipped: dataset is "
+                     "device-resident (tpu_ingest_device); set "
+                     "tpu_ingest_device=false to restore EFB")
+        if (config.enable_bundle and F >= 2 and not self._shard_features
+                and _host_bins_free):
             mappers = [self.train_set.bin_mappers[f]
                        for f in self.train_set.used_features]
             eligible = np.array(
@@ -1069,11 +1150,24 @@ class GBDT:
             # be < the feature's bin count. (Skipped under EFB — the
             # physical bundle columns use offset bin spaces that the
             # logical feat_num_bin does not describe.)
-            if not self.has_bundles and len(self.train_set.binned):
+            _ing = self.train_set.device_ingested()
+            if not self.has_bundles and (
+                    _ing.n_rows if _ing is not None
+                    else len(self.train_set.binned)):
                 nb_host = np.asarray(self.feat_num_bin)
-                binned_chk = self.train_set.binned
-                F_chk = min(binned_chk.shape[1], len(nb_host))
-                col_max = binned_chk[:, :F_chk].max(axis=0)
+                if _ing is not None and getattr(
+                        self.train_set, "_binned", None) is None:
+                    # device-resident dataset: audit the device array
+                    # (pad rows are bin 0 — never the max) instead of
+                    # D2H-materializing and permanently caching a full
+                    # host copy just for a check
+                    F_chk = min(_ing.bins.shape[1], len(nb_host))
+                    col_max = np.asarray(
+                        jnp.max(_ing.bins[:, :F_chk], axis=0))
+                else:
+                    binned_chk = self.train_set.binned
+                    F_chk = min(binned_chk.shape[1], len(nb_host))
+                    col_max = binned_chk[:, :F_chk].max(axis=0)
                 bad = np.nonzero(col_max >= nb_host[:F_chk])[0]
                 if len(bad):
                     log.fatal(f"tpu_debug: out-of-bounds bin ids in "
@@ -2332,7 +2426,7 @@ class GBDT:
         # (Dataset._bin_all_columns; the strided per-column fallback
         # otherwise) — same binning the training construct used
         src = Xc if sparse_in else X
-        bins = ds._bin_all_columns(src, sparse_in, ds.binned.dtype,
+        bins = ds._bin_all_columns(src, sparse_in, ds.binned_dtype(),
                                    n_rows=n_rows)
         total_iters = len(self.models) // self.num_class
         if num_iteration <= 0:

@@ -200,6 +200,12 @@ class StreamingGBDT:
         self.metrics = metrics_for_config(config)
 
         self.binned = ds.binned                     # host [n, F] uint
+        if ds.device_ingested() is not None:
+            # the streaming engine scans host blocks only — release a
+            # device-resident ingest copy (possible when a standalone
+            # construct picked device ingest before a forced
+            # tpu_streaming run) instead of leaving it orphaned in HBM
+            ds._ingest = None
         self.n = int(ds.num_data)
         F = len(ds.used_features)
         self.num_features = F

@@ -345,6 +345,29 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # host re-stacking and HBM re-upload entirely (invalidated on any
     # model mutation)
     "tpu_predict_cache": _P("bool", True),
+    # ---- device-accelerated ingest (ops/ingest.py; docs/perf.md
+    # "Ingest") -------------------------------------------------------
+    # bin ASSIGNMENT of the full raw matrix on the accelerator (bin
+    # boundary FINDING stays host-side on the sample): "auto" takes the
+    # device path on a TPU backend for dense numeric input; "true"
+    # forces it on any backend (what the bit-equality tests do);
+    # "false" keeps the host binning loop. The device path is
+    # bit-identical to the host path for every float32-representable
+    # value (ops/ingest.py's exclusive-f32 boundary trick); genuinely-
+    # float64 values within half an f32 ulp of a bin edge may land one
+    # bin off — set "false" for strict f64 edge semantics.
+    "tpu_ingest_device": _P("str", "auto"),
+    # raw rows per streamed H2D ingest chunk (every chunk the same
+    # padded shape -> the assignment kernel compiles once)
+    "tpu_ingest_chunk_rows": _P("int", 262144, [], (4096, None)),
+    # host-fallback binning threads for the per-column numpy loop
+    # (0 = auto: one per core, capped); only engages on large matrices
+    "tpu_ingest_threads": _P("int", 0, [], (0, 256)),
+    # persistent XLA compilation cache directory (jax
+    # jax_compilation_cache_dir): warm-start repeat jobs so the second
+    # construct+engine-init of the same shape compiles ZERO programs
+    # (production retrains pay cold compiles on every job otherwise)
+    "tpu_compile_cache_dir": _P("str", ""),
     # leaf-histogram storage: "pool" keeps the [L+1, F, B, 3] carry and
     # derives siblings by subtraction (the reference's HistogramPool);
     # "rebuild" computes BOTH children per round in one scan — the masks
@@ -575,10 +598,11 @@ class Config:
         if str(self.tpu_hist_mode) not in ("pool", "rebuild"):
             log.fatal(f"Unknown tpu_hist_mode {self.tpu_hist_mode!r} "
                       f"(expected 'pool' or 'rebuild')")
-        self.tpu_streaming = str(self.tpu_streaming).lower()
-        if self.tpu_streaming not in ("auto", "true", "false"):
-            log.fatal(f"Unknown tpu_streaming {self.tpu_streaming!r} "
-                      f"(expected 'auto', 'true' or 'false')")
+        self.tpu_streaming = coerce_tristate(self.tpu_streaming,
+                                             "tpu_streaming")
+        self.tpu_ingest_device = coerce_tristate(self.tpu_ingest_device,
+                                                 "tpu_ingest_device")
+        setup_compile_cache(self.tpu_compile_cache_dir)
         for m in (self.monotone_constraints or []):
             if int(m) not in (-1, 0, 1):
                 log.fatal("monotone_constraints must be -1, 0 or 1, "
@@ -637,6 +661,75 @@ class Config:
 def coerce_bool(value: Any) -> bool:
     """Public string-aware bool coercion ('false'/'0'/'off' are False)."""
     return _coerce("<bool>", "bool", value)
+
+
+_TRISTATE_VALUES = {"true": "true", "1": "true", "on": "true",
+                    "yes": "true",
+                    "false": "false", "0": "false", "off": "false",
+                    "no": "false",
+                    "auto": "auto"}
+
+
+def coerce_tristate(value: Any, name: str = "parameter") -> str:
+    """Normalize an auto/true/false knob to its canonical spelling,
+    accepting the same bool spellings coerce_bool does ('on'/'1'/'yes',
+    'off'/'0'/'no') — Config validation and Dataset-side param reads
+    share this one accept-list."""
+    v = _TRISTATE_VALUES.get(str(value).strip().lower())
+    if v is None:
+        log.fatal(f"Unknown {name} {value!r} (expected 'auto', "
+                  f"'true'/'1'/'on'/'yes' or 'false'/'0'/'off'/'no')")
+    return v
+
+
+# the one directory the persistent compile cache is pointed at; set-once
+# per process (jax's cache is a process-global — flipping it mid-run
+# would silently split the cache)
+_COMPILE_CACHE_DIR: Optional[str] = None
+
+
+def setup_compile_cache(path) -> None:
+    """Point jax's persistent compilation cache at ``path`` (the
+    ``tpu_compile_cache_dir`` warm-start knob): a second same-shape run
+    in a fresh process reloads every XLA program from disk instead of
+    recompiling, collapsing cold-start ``engine_init_s`` /
+    first-iteration compile time. Idempotent; an empty path is a no-op;
+    a second DIFFERENT path warns and keeps the first (the cache dir is
+    process-global in jax)."""
+    global _COMPILE_CACHE_DIR
+    path = str(path or "").strip()
+    if not path:
+        return
+    if _COMPILE_CACHE_DIR is not None:
+        if _COMPILE_CACHE_DIR != path:
+            log.warning(
+                f"tpu_compile_cache_dir={path!r} ignored: the persistent "
+                f"compile cache is already at {_COMPILE_CACHE_DIR!r} "
+                f"(process-global; restart to move it)")
+        return
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as e:    # older jax without this config name
+        log.warning(f"tpu_compile_cache_dir: persistent compilation "
+                    f"cache unavailable on this jax ({e})")
+        return
+    # the cache is LIVE from here: record it before the optional tuning
+    # below, so a partial failure can never leave an active cache that
+    # a later different path would silently re-point
+    _COMPILE_CACHE_DIR = path
+    try:
+        # cache even quick compiles: the warm-start contract is "second
+        # run compiles nothing", not "second run compiles only the big
+        # ones" — and entry write cost is trivial next to any compile
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+    except Exception as e:    # tuning knobs absent: cache still works
+        log.warning(f"tpu_compile_cache_dir: cache enabled but "
+                    f"min-compile-time/entry-size tuning unavailable "
+                    f"({e}); small programs may not be cached")
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

@@ -115,6 +115,18 @@ def _greedy_find_distinct_bounds(distinct_values: np.ndarray,
     return bounds
 
 
+def resolve_ingest_threads(n_threads: int) -> int:
+    """The ONE tpu_ingest_threads resolution rule (0/unset = one per
+    core, capped) — shared by mapper finding, the native row-chunked
+    binning pass and the per-column fallback so the knob can never mean
+    different things on different paths. Callers apply their own
+    work-size gates on top."""
+    if n_threads and n_threads > 0:
+        return int(n_threads)
+    import os
+    return min(os.cpu_count() or 1, 16)
+
+
 def _distinct_with_counts(values: np.ndarray):
     if len(values) == 0:
         return np.empty(0), np.empty(0, dtype=np.int64)
@@ -387,12 +399,16 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
                      categorical_features: Optional[List[int]] = None,
                      max_bin_by_feature: Optional[List[int]] = None,
                      seed: int = 1,
-                     forced_bins: Optional[Dict[int, List[float]]] = None
-                     ) -> List[BinMapper]:
+                     forced_bins: Optional[Dict[int, List[float]]] = None,
+                     n_threads: int = 0) -> List[BinMapper]:
     """Build a BinMapper per column of ``X`` from a row sample.
 
     Mirrors DatasetLoader::ConstructFromSampleData's sampling step
-    (src/io/dataset_loader.cpp, UNVERIFIED).
+    (src/io/dataset_loader.cpp, UNVERIFIED). Per-feature boundary
+    finding is independent and numpy-sort dominated (sorts release the
+    GIL), so columns run on a thread pool when the sample is big enough
+    to pay for it; results are position-ordered, so the mapper list is
+    identical to the serial loop's.
     """
     n_rows, n_features = X.shape
     categorical = set(categorical_features or [])
@@ -409,8 +425,8 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
     if is_sparse:
         sample = sample.tocsc()
     n_sample = sample.shape[0]
-    mappers = []
-    for f in range(n_features):
+
+    def build_one(f: int) -> BinMapper:
         mb = max_bin
         if max_bin_by_feature and f < len(max_bin_by_feature) \
                 and max_bin_by_feature[f] > 0:
@@ -418,11 +434,17 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
         col = sample[:, f]
         if is_sparse:
             col = np.asarray(col.todense(), dtype=np.float64).ravel()
-        mappers.append(BinMapper.from_sample(
+        return BinMapper.from_sample(
             col, n_sample, mb, min_data_in_bin, use_missing,
             zero_as_missing, is_categorical=(f in categorical),
-            forced_bounds=(forced_bins or {}).get(f)))
-    return mappers
+            forced_bounds=(forced_bins or {}).get(f))
+
+    n_threads = min(resolve_ingest_threads(n_threads), n_features)
+    if n_threads > 1 and n_sample * n_features >= 1_000_000:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=n_threads) as ex:
+            return list(ex.map(build_one, range(n_features)))
+    return [build_one(f) for f in range(n_features)]
 
 
 def mappers_from_params(X, params: Dict, categorical_idx=None,
@@ -445,7 +467,8 @@ def mappers_from_params(X, params: Dict, categorical_idx=None,
         max_bin_by_feature=p.get("max_bin_by_feature"),
         seed=int(p.get("data_random_seed", 1)),
         forced_bins=(load_forced_bins(str(p["forcedbins_filename"]))
-                     if p.get("forcedbins_filename") else None))
+                     if p.get("forcedbins_filename") else None),
+        n_threads=int(p.get("tpu_ingest_threads", 0) or 0))
 
 
 def load_forced_bins(path: str) -> Dict[int, List[float]]:

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..utils import log
 from .binning import (BIN_TYPE_CATEGORICAL, BinMapper, find_bin_mappers,
-                      load_forced_bins)
+                      load_forced_bins, resolve_ingest_threads)
 
 
 def _host_mem_bytes():
@@ -175,6 +175,7 @@ class Dataset:
         # filled by construct()
         self._constructed = False
         self.bin_mappers: List[BinMapper] = []
+        self._ingest = None          # device-resident ingest result
         self.binned: Optional[np.ndarray] = None   # [n_rows, n_used]
         self.used_features: List[int] = []         # original feature indices
         self.num_total_features = 0
@@ -186,6 +187,43 @@ class Dataset:
         import os as _os
         if isinstance(data, (str, _os.PathLike)):
             self._init_from_file(_os.fspath(data))
+
+    # ------------------------------------------------------------------
+    @property
+    def binned(self) -> Optional[np.ndarray]:
+        """Host ``[n, n_used]`` binned matrix. Under device ingest
+        (``tpu_ingest_device``) the matrix lives on the accelerator and
+        the host copy materializes LAZILY here, only for the paths that
+        genuinely need host bytes (save_binary / EFB bundling / subset /
+        model-text round trips) — training reads the device arrays
+        directly via ``device_ingested()``."""
+        b = getattr(self, "_binned", None)
+        if b is None:
+            ing = getattr(self, "_ingest", None)
+            if ing is not None:
+                b = ing.host_binned()
+                self._binned = b
+        return b
+
+    @binned.setter
+    def binned(self, value) -> None:
+        self._binned = value
+
+    def device_ingested(self):
+        """The on-device ingest result (ops/ingest.DeviceIngestResult)
+        or None when this dataset was binned host-side."""
+        return getattr(self, "_ingest", None)
+
+    def binned_dtype(self):
+        """Bin-id dtype WITHOUT forcing a host materialization of a
+        device-resident binned matrix (predict needs only the dtype)."""
+        b = getattr(self, "_binned", None)
+        if b is not None:
+            return b.dtype
+        ing = getattr(self, "_ingest", None)
+        if ing is not None:
+            return np.dtype(ing.bins.dtype)
+        return self.binned.dtype
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -260,6 +298,10 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
+        # warm-start: point jax's persistent compile cache BEFORE the
+        # first construct-time kernel (the ingest assignment jit)
+        from ..config import setup_compile_cache
+        setup_compile_cache(self.params.get("tpu_compile_cache_dir"))
         if getattr(self, "_stream_path", None):
             return self._construct_streamed()
         if self._finish_pushed():
@@ -341,7 +383,16 @@ class Dataset:
                             "the provided configuration.")
 
         dtype = self._binned_dtype_with_guard()
-        self.binned = self._bin_all_columns(X, is_sparse, dtype)
+        if self._want_device_ingest(X, is_sparse, dtype):
+            from ..ops.ingest import device_ingest
+            self._ingest = device_ingest(
+                X, self.bin_mappers, self.used_features, dtype,
+                chunk_rows=int(self.params.get("tpu_ingest_chunk_rows",
+                                               262_144)),
+                emit_transposed=self._want_transposed_ingest(dtype))
+            self.binned = None    # host copy materializes lazily
+        else:
+            self.binned = self._bin_all_columns(X, is_sparse, dtype)
         from ..config import coerce_bool as _cb
         if _cb(self.params.get("linear_tree", False)):
             if is_sparse:
@@ -352,6 +403,108 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _want_device_ingest(self, X, is_sparse: bool, dtype) -> bool:
+        """Route bin ASSIGNMENT to the accelerator (ops/ingest.py)?
+        "true" forces; "auto" engages on a TPU backend for dense
+        numeric ndarray input big enough to amortize the dispatch —
+        but stands down when the binned matrix would not comfortably
+        fit in HBM (the >HBM case belongs to the streaming engine's
+        host-resident bins); "false" (or sparse / non-numeric / no
+        usable features) keeps the host loop. Even forced "true"
+        yields to a forced streaming engine (its host-block scan never
+        adopts device bins — they would sit orphaned in HBM) and to
+        categorical ids outside the exact float32/int32 window (the
+        f32 chunk stream cannot represent them; the host int64 path
+        can)."""
+        from ..config import coerce_tristate
+        mode = coerce_tristate(
+            self.params.get("tpu_ingest_device", "auto"),
+            "tpu_ingest_device")
+        if mode == "false":
+            return False
+        if (is_sparse or not isinstance(X, np.ndarray) or X.ndim != 2
+                or X.dtype not in (np.float32, np.float64)
+                or not self.used_features):
+            return False
+        forced = mode == "true"
+        if coerce_tristate(self.params.get("tpu_streaming", "auto"),
+                           "tpu_streaming") == "true":
+            # forced out-of-core training keeps bins host-resident;
+            # device-resident ingest output would sit orphaned in HBM
+            if forced:
+                log.warning("tpu_ingest_device=true ignored: "
+                            "tpu_streaming=true keeps bins "
+                            "host-resident")
+            return False
+        from ..ops.ingest import cat_device_safe
+        if not cat_device_safe(self.bin_mappers, self.used_features):
+            if forced:
+                log.warning("tpu_ingest_device=true ignored: "
+                            "categorical ids exceed the exact "
+                            "float32/int32 device window; binning "
+                            "host-side")
+            return False
+        from ..utils.hbm import (STREAM_HBM_FRACTION, binned_device_bytes,
+                                 hbm_bytes_limit)
+        limit = hbm_bytes_limit()
+        if limit:
+            est = binned_device_bytes(
+                self.num_data, len(self.used_features),
+                np.dtype(dtype).itemsize,
+                self._want_transposed_ingest(dtype))
+            # budget 2x the resident size: the chunk parts AND the
+            # final concatenated arrays are alive together at the end
+            # of device_ingest, so transient peak is ~double. Even a
+            # FORCED device ingest stands down here — past this size
+            # auto-streaming (boosting._should_stream, same helper)
+            # picks the host-block engine, which never adopts device
+            # bins: they would sit orphaned in HBM
+            if 2 * est > STREAM_HBM_FRACTION * limit:
+                if forced:
+                    log.warning("tpu_ingest_device=true ignored: binned "
+                                "matrix too large to sit comfortably in "
+                                "HBM (streaming territory); binning "
+                                "host-side")
+                return False
+        # a distributed learner on >1 device will SHARD host numpy in
+        # _DeviceData — device-resident single-device bins would just be
+        # materialized back to host and re-uploaded sharded (strictly
+        # slower than host binning), so even forced mode stands down
+        import jax
+        if jax.device_count() > 1:
+            from ..config import Config
+            tl = "serial"
+            for k, v in self.params.items():
+                if Config.canonical_name(k) == "tree_learner":
+                    tl = str(v).lower()
+            if tl not in ("serial",):
+                if forced:
+                    log.warning("tpu_ingest_device=true ignored: a "
+                                "distributed tree_learner shards "
+                                "host-binned data; binning host-side")
+                return False
+        if forced:
+            return True
+        if jax.default_backend() != "tpu" or self.num_data < 65_536:
+            return False
+        return True
+
+    def _want_transposed_ingest(self, dtype) -> bool:
+        """Emit the feature-major int8 ``bins_t`` tile during ingest?
+        Mirrors the engine's Pallas-kernel gate (uint8 bins + TPU +
+        tpu_use_pallas) so the host transpose in ``_DeviceData`` never
+        runs — the fused kernel writes both layouts per chunk."""
+        from ..config import coerce_bool
+        if np.dtype(dtype) != np.uint8:
+            return False
+        if not coerce_bool(self.params.get("tpu_use_pallas", True)):
+            return False
+        if coerce_bool(self.params.get("tpu_double_precision_hist",
+                                       False)):
+            return False
+        import jax
+        return jax.default_backend() == "tpu"
 
     def _bin_all_columns(self, X, is_sparse: bool, dtype,
                          n_rows: int = None) -> np.ndarray:
@@ -400,35 +553,79 @@ class Dataset:
             out_kind = {np.uint8: 0, np.uint16: 1,
                         np.int32: 2}[np.dtype(dtype).type]
             c = ctypes
-            lib.bin_matrix(
-                X.ctypes.data_as(c.c_void_p),
-                int(X.dtype == np.float32), n_rows,
-                X.strides[0] // X.itemsize,
-                col_idx.ctypes.data_as(c.POINTER(c.c_int64)), n_cols,
-                ub_concat.ctypes.data_as(c.POINTER(c.c_double)),
-                ub_off.ctypes.data_as(c.POINTER(c.c_int64)),
-                meta_mt.ctypes.data_as(c.POINTER(c.c_int32)),
-                meta_db.ctypes.data_as(c.POINTER(c.c_int64)),
-                meta_nb.ctypes.data_as(c.POINTER(c.c_int64)),
-                is_num.ctypes.data_as(c.POINTER(c.c_int32)),
-                out.ctypes.data_as(c.c_void_p), out_kind)
+            row_stride = X.strides[0] // X.itemsize
+
+            def bin_rows(s: int, e: int) -> None:
+                lib.bin_matrix(
+                    c.c_void_p(X.ctypes.data
+                               + s * row_stride * X.itemsize),
+                    int(X.dtype == np.float32), e - s, row_stride,
+                    col_idx.ctypes.data_as(c.POINTER(c.c_int64)),
+                    n_cols,
+                    ub_concat.ctypes.data_as(c.POINTER(c.c_double)),
+                    ub_off.ctypes.data_as(c.POINTER(c.c_int64)),
+                    meta_mt.ctypes.data_as(c.POINTER(c.c_int32)),
+                    meta_db.ctypes.data_as(c.POINTER(c.c_int64)),
+                    meta_nb.ctypes.data_as(c.POINTER(c.c_int64)),
+                    is_num.ctypes.data_as(c.POINTER(c.c_int32)),
+                    c.c_void_p(out.ctypes.data
+                               + s * n_cols * out.itemsize), out_kind)
+
+            # row-chunked thread parallelism over the native pass:
+            # ctypes releases the GIL for the call's duration and each
+            # chunk writes a disjoint out slice, so the kernel scales
+            # with cores (it is per-value binary search — pure CPU)
+            n_threads = min(
+                resolve_ingest_threads(
+                    int(self.params.get("tpu_ingest_threads", 0) or 0)),
+                max(n_rows // 262_144, 1))
+            if n_threads > 1:
+                from concurrent.futures import ThreadPoolExecutor
+                blk = -(-n_rows // n_threads)
+                spans = [(s, min(s + blk, n_rows))
+                         for s in range(0, n_rows, blk)]
+                with ThreadPoolExecutor(max_workers=n_threads) as ex:
+                    list(ex.map(lambda se: bin_rows(*se), spans))
+            else:
+                bin_rows(0, n_rows)
             for j, f in enumerate(used):     # categorical remainder
                 if not is_num[j]:
                     out[:, j] = self.bin_mappers[f].values_to_bins(
                         X[:, f]).astype(dtype)
             return out
-        cols = []
-        for f in used:
+
+        def col_values(f):
             if is_sparse:
                 # X is the CSC matrix here (construct passes it through)
                 colv = np.zeros(n_rows, np.float64)
                 sl = slice(X.indptr[f], X.indptr[f + 1])
                 colv[X.indices[sl]] = X.data[sl]
-            else:
-                colv = X[:, f]
-            cols.append(self.bin_mappers[f].values_to_bins(colv)
-                        .astype(dtype))
-        return np.stack(cols, axis=1)
+                return colv
+            return X[:, f]
+
+        # per-column fallback: thread-pooled for non-accelerator users
+        # (numpy's searchsorted/unique release the GIL, so columns bin
+        # in parallel); small jobs keep the serial loop — pool startup
+        # would dominate
+        n_threads = min(
+            resolve_ingest_threads(
+                int(self.params.get("tpu_ingest_threads", 0) or 0)),
+            len(used))
+        if n_threads > 1 and n_rows * len(used) >= 2_000_000:
+            from concurrent.futures import ThreadPoolExecutor
+            out = np.empty((n_rows, len(used)), dtype=dtype)
+
+            def bin_one(jf):
+                j, f = jf
+                out[:, j] = self.bin_mappers[f].values_to_bins(
+                    col_values(f))
+
+            with ThreadPoolExecutor(max_workers=n_threads) as ex:
+                list(ex.map(bin_one, enumerate(used)))
+            return out
+        return np.stack(
+            [self.bin_mappers[f].values_to_bins(col_values(f))
+             .astype(dtype) for f in used], axis=1)
 
     # ------------------------------------------------------------------
     def _binned_dtype_with_guard(self):
@@ -563,6 +760,8 @@ class Dataset:
                 categorical_features=cat_idx,
                 max_bin_by_feature=p.get("max_bin_by_feature"),
                 seed=int(p.get("data_random_seed", 1)),
+                n_threads=resolve_ingest_threads(
+                    int(p.get("tpu_ingest_threads", 0) or 0)),
                 forced_bins=(load_forced_bins(
                     str(p["forcedbins_filename"]))
                     if p.get("forcedbins_filename") else None))
@@ -575,15 +774,20 @@ class Dataset:
                             "satisfy the provided configuration.")
 
         # ---- round 2: bin chunk-by-chunk into the packed matrix ------
+        # each chunk goes through _bin_all_columns — the SAME ingest
+        # path push_rows and construct use (native one-pass row-major
+        # binning, thread-pooled fallback) — instead of the per-column
+        # strided loop; peak memory stays one raw chunk + the binned
+        # matrix (pinned by the peak-RSS test in test_io_files.py)
         dtype = self._binned_dtype_with_guard()
         self.binned = np.empty((n_total, len(self.used_features)),
                                dtype=dtype)
         r0 = 0
         for ch in chunks():
             r1 = r0 + len(ch.X)
-            for i, f in enumerate(self.used_features):
-                self.binned[r0:r1, i] = \
-                    self.bin_mappers[f].values_to_bins(ch.X[:, f])
+            self.binned[r0:r1] = self._bin_all_columns(
+                np.ascontiguousarray(ch.X), False, dtype,
+                n_rows=len(ch.X))
             r0 = r1
         if r0 != n_total:
             log.fatal(f"file changed between streaming rounds: "
@@ -623,7 +827,7 @@ class Dataset:
             if chunk.shape[1] != ref.num_total_features:
                 log.fatal(f"pushed chunk has {chunk.shape[1]} features, "
                           f"reference has {ref.num_total_features}")
-            dtype = ref.binned.dtype
+            dtype = ref.binned_dtype()
             if ref.used_features:
                 # native one-pass binning (same hot path construct and
                 # predict use) — the per-column Python fallback is

@@ -256,10 +256,14 @@ def load_text(path, label_column="auto", weight_column=None,
 def _split_chunk_columns(X: np.ndarray, names, lbl_idx, w_idx, g_idx,
                          drop) -> LoadedText:
     keep = [i for i in range(X.shape[1]) if i not in drop]
+    # metadata columns are COPIES, not views: the streamed loader
+    # accumulates label/weight chunks across the whole file, and a view
+    # would pin every raw [chunk, F+meta] parse block in memory — the
+    # exact full-matrix footprint streaming exists to avoid
     return LoadedText(
         X=X[:, keep],
-        label=X[:, lbl_idx] if lbl_idx is not None else None,
-        weight=X[:, w_idx] if w_idx is not None else None,
+        label=X[:, lbl_idx].copy() if lbl_idx is not None else None,
+        weight=X[:, w_idx].copy() if w_idx is not None else None,
         qid=(X[:, g_idx].astype(np.int64) if g_idx is not None
              else None),
         feature_names=([names[i] for i in keep] if names else None))

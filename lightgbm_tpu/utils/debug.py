@@ -38,10 +38,24 @@ class CompileWatch:
     ``<= bound`` with slack, never an exact nonzero count.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, name: str = "") -> None:
+        self.name = name
         self.compiles = 0
         self.events: List[str] = []
         self._active = False
+
+    def assert_compiles(self, at_most: int = 0) -> None:
+        """Assert at most ``at_most`` compile requests were seen,
+        failing with the captured event list (the warm-start pin:
+        ``w.assert_compiles(0)`` after a second same-shape
+        construct+engine-init reads "no new XLA program was built")."""
+        if self.compiles > at_most:
+            compile_events = [e for e in self.events if
+                              e.startswith(_COMPILE_EVENT_PREFIX)]
+            raise AssertionError(
+                f"CompileWatch{f' {self.name!r}' if self.name else ''}: "
+                f"{self.compiles} compile request(s), expected at most "
+                f"{at_most}. Events: {compile_events[:10]}")
 
     def _listener(self, event: str, **kwargs) -> None:
         if not self._active:
@@ -74,4 +88,13 @@ def predict_program_cache_size() -> int:
     """Distinct compiled forest-traversal programs held by this process
     (the quantity batch-shape bucketing bounds)."""
     from ..ops.predict import predict_program_cache_size as _sz
+    return _sz()
+
+
+def ingest_program_cache_size() -> int:
+    """Distinct compiled device bin-assignment programs (ops/ingest.py)
+    held by this process — fixed-shape chunking promises ONE per
+    (chunk_rows, features, bins) family, and a second same-shape
+    ``Dataset.construct`` must not add any (test_ingest.py pins both)."""
+    from ..ops.ingest import ingest_program_cache_size as _sz
     return _sz()

@@ -1,0 +1,43 @@
+"""Shared HBM budgeting: one limit probe + one size estimate.
+
+Three gates reason about the same quantity — "how much HBM does the
+binned dataset occupy device-resident?" — and their numeric agreement
+is load-bearing: a dataset the device-ingest gate (io/dataset.py)
+keeps on the accelerator must never be one the auto-streaming gate
+(boosting/__init__.py) then hands to the host-block engine, or the
+device copy sits orphaned in HBM for the whole run. The engine's own
+capacity guard (boosting/gbdt.py) fatals on the same estimate. Keeping
+the probe, the estimate and the thresholds here means the gates cannot
+drift apart.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+# auto-streaming engages above this fraction of HBM (with margin for
+# histograms/score/partition); the device-ingest gate stands down at
+# the same line so the two autos stay disjoint
+STREAM_HBM_FRACTION = 0.6
+
+# the resident engine fatals (actionable message instead of an opaque
+# device OOM) above this fraction
+ENGINE_HBM_FRACTION = 0.92
+
+
+def hbm_bytes_limit() -> Optional[int]:
+    """``bytes_limit`` of device 0, or None (CPU / older runtimes that
+    expose no memory stats — every caller treats None as "no gate")."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_limit") or None
+    except Exception:
+        return None
+
+
+def binned_device_bytes(n_rows: int, n_features: int, itemsize: int,
+                        with_transposed: bool = True) -> int:
+    """Device-resident footprint of a binned dataset: the row-major
+    bins plus (Pallas path) the same-size feature-major int8 tile."""
+    return (int(n_rows) * int(n_features) * int(itemsize)
+            * (2 if with_transposed else 1))

@@ -8,16 +8,41 @@
 # Rationale: round 4's final commit shipped an undefined variable in
 # GBDT.predict() that failed 111/249 tests and blanked BENCH_r04. This
 # script is the discipline that prevents a recurrence.
+#
+# Wall-clock guard: every run appends "date git-rev mode dots seconds"
+# to scripts/check_timings.log (also summarized in the verify skill,
+# .claude/skills/verify/SKILL.md). A suite that suddenly takes longer
+# at the same dot count is a perf regression in the library the tests
+# exercise (e.g. an ingest slowdown taxing every construct) — review
+# the log's trend, not just the green.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+LOG=/tmp/_check_run.log
+MODE=smoke
+RC=0
+T0=$(date +%s)
 if [[ "${1:-}" == "--full" ]]; then
-  python -m pytest tests/ -x -q
+  MODE=full
+  python -m pytest tests/ -x -q 2>&1 | tee "$LOG" || RC=$?
 else
-  python -m pytest tests/test_smoke_gate.py tests/test_engine.py -x -q
+  python -m pytest tests/test_smoke_gate.py tests/test_engine.py \
+    tests/test_ingest.py -x -q 2>&1 | tee "$LOG" || RC=$?
+fi
+T1=$(date +%s)
+# log EVERY run, green or red — a failing/slow run is exactly the
+# datapoint the trend review needs
+DOTS=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c || true)
+REV=$(git rev-parse --short HEAD 2>/dev/null || echo nogit)
+printf '%s %s %s dots=%s secs=%s rc=%s\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$REV" "$MODE" "$DOTS" "$((T1 - T0))" \
+  "$RC" >> scripts/check_timings.log
+if [[ "$RC" != 0 ]]; then
+  echo "check.sh: tests FAILED (rc=$RC; timing logged)"
+  exit "$RC"
 fi
 
 # tiny bench: exercises the real flagship path end to end (train +
 # predict + AUC) and proves bench.py emits its JSON line with rc=0
 python bench.py --rows 300000 --iters 5 --smoke
-echo "check.sh: OK"
+echo "check.sh: OK (timing logged to scripts/check_timings.log)"

@@ -1,0 +1,320 @@
+"""Device-accelerated ingest (ops/ingest.py): bit-equality + warm-start.
+
+The contract under test:
+1. Device-assigned bins are BIT-IDENTICAL to the host
+   ``BinMapper.values_to_bins`` path across numerical/categorical
+   features, every ``missing_type`` (none/zero/nan), ``zero_as_missing``,
+   forced bounds, and >256-bin uint16 layouts.
+2. The feature-major ``bins_t`` tile matches the host
+   ``binned.T.astype(int8)`` wraparound layout exactly.
+3. Fixed-shape chunking + the jit cache mean a SECOND same-shape
+   ``Dataset.construct`` (and engine build) compiles ZERO new XLA
+   programs — the warm-start serving metric.
+4. End-to-end: training on a device-ingested dataset produces the same
+   model text as the host path.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.binning import BinMapper, find_bin_mappers
+from lightgbm_tpu.ops.ingest import (build_tables, device_ingest,
+                                     ingest_program_cache_size)
+from lightgbm_tpu.utils.debug import CompileWatch
+
+
+def _f32_matrix(n, f, seed=0, nan_cols=(), zero_cols=(), cat_cols=(),
+                cat_card=20):
+    """f32-representable float64 matrix (the exactness contract's
+    domain) with missing values, exact zeros and categorical columns."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32).astype(np.float64)
+    for c in zero_cols:
+        X[:, c] = np.where(rng.uniform(size=n) < 0.3, 0.0, X[:, c])
+    for c in cat_cols:
+        X[:, c] = rng.integers(0, cat_card, size=n).astype(np.float64)
+    for c in nan_cols:
+        X[rng.uniform(size=n) < 0.1, c] = np.nan
+    return X
+
+
+def _host_bins(X, mappers, used, dtype=np.uint8):
+    return np.stack([mappers[f].values_to_bins(X[:, f]).astype(dtype)
+                     for f in used], axis=1)
+
+
+def _device_vs_host(X, mappers, chunk_rows=1024, dtype=np.uint8,
+                    transposed=True):
+    used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+    host = _host_bins(X, mappers, used, dtype)
+    res = device_ingest(X, mappers, used, dtype, chunk_rows=chunk_rows,
+                        emit_transposed=transposed)
+    dev = np.asarray(res.bins)
+    np.testing.assert_array_equal(host, dev)
+    if transposed:
+        np.testing.assert_array_equal(host.T.astype(np.int8),
+                                      np.asarray(res.bins_t))
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-equality across the mapping semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("use_missing", [True, False])
+@pytest.mark.parametrize("zero_as_missing", [False, True])
+def test_bit_equality_missing_semantics(use_missing, zero_as_missing):
+    X = _f32_matrix(7013, 6, seed=3, nan_cols=(1, 2), zero_cols=(2, 4))
+    mappers = find_bin_mappers(X, max_bin=64, use_missing=use_missing,
+                               zero_as_missing=zero_as_missing)
+    _device_vs_host(X, mappers)
+
+
+def test_bit_equality_categorical():
+    X = _f32_matrix(5003, 5, seed=4, nan_cols=(1,), cat_cols=(2, 3),
+                    cat_card=40)
+    # category 3 also gets out-of-range/negative raw values (must map
+    # to the NaN/unseen bin 0, like the host path)
+    rng = np.random.default_rng(9)
+    X[rng.uniform(size=len(X)) < 0.05, 3] = -7.0
+    X[rng.uniform(size=len(X)) < 0.05, 3] = 10_000.0
+    mappers = find_bin_mappers(X, max_bin=32,
+                               categorical_features=[2, 3])
+    _device_vs_host(X, mappers)
+
+
+def test_bit_equality_high_cardinality_categorical():
+    # large id space exercises the sorted-table binary search (the
+    # kernel must stay O(R*Fu*log C) — no [R, Fu, C] broadcast)
+    X = _f32_matrix(6007, 4, seed=21, cat_cols=(1,), cat_card=1500)
+    mappers = find_bin_mappers(X, max_bin=255, categorical_features=[1])
+    _device_vs_host(X, mappers, dtype=np.uint16)
+
+
+def test_large_categorical_ids_fall_back_to_host():
+    # 64-bit hash-style ids sit outside the exact float32/int32 window
+    # (the f32 chunk stream cannot represent them): build_tables must
+    # refuse, and even forced tpu_ingest_device=true must stand down to
+    # the host int64 path — with bins identical to a plain host run
+    from lightgbm_tpu.ops.ingest import cat_device_safe
+    X = _f32_matrix(2003, 3, seed=22, cat_cols=(2,), cat_card=10)
+    X[::7, 2] = float(2**32 + 5)    # exact in f64, wraps int32 to 5
+    mappers = find_bin_mappers(X, max_bin=32, categorical_features=[2])
+    used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+    assert not cat_device_safe(mappers, used)
+    with pytest.raises(ValueError):
+        build_tables(mappers, used, np.uint8)
+    y = (X[:, 0] > 0).astype(float)
+    dsd = lgb.Dataset(X, label=y, categorical_feature=[2],
+                      params={"tpu_ingest_device": True,
+                              "verbosity": -1}).construct()
+    assert dsd.device_ingested() is None
+    dsh = lgb.Dataset(X, label=y, categorical_feature=[2],
+                      params={"tpu_ingest_device": False,
+                              "verbosity": -1}).construct()
+    np.testing.assert_array_equal(np.asarray(dsd.binned),
+                                  np.asarray(dsh.binned))
+
+
+def test_bit_equality_float32_input_and_odd_chunks():
+    X64 = _f32_matrix(4999, 4, seed=5, nan_cols=(0,), zero_cols=(1,))
+    X32 = X64.astype(np.float32)
+    mappers = find_bin_mappers(X64, max_bin=255)
+    # chunk size that never divides the row count: the padded tail
+    # chunk must slice away cleanly
+    _device_vs_host(X32, mappers, chunk_rows=777)
+    _device_vs_host(X64, mappers, chunk_rows=777)
+
+
+def test_bit_equality_forced_bounds():
+    X = _f32_matrix(3001, 3, seed=6, zero_cols=(1,))
+    mappers = find_bin_mappers(
+        X, max_bin=32, forced_bins={0: [-1.0, 0.25, 1.5],
+                                    2: [0.0, 0.5]})
+    _device_vs_host(X, mappers)
+
+
+def test_bit_equality_inf_values():
+    X = _f32_matrix(2003, 3, seed=7)
+    X[5, 0] = np.inf
+    X[6, 0] = -np.inf
+    mappers = find_bin_mappers(X, max_bin=32)
+    _device_vs_host(X, mappers)
+
+
+def test_bit_equality_uint16_wide_bins():
+    rng = np.random.default_rng(8)
+    n = 9000
+    # >256 distinct values so max_bin=600 genuinely exceeds uint8
+    X = np.round(rng.normal(size=(n, 2)) * 500).astype(np.float32) \
+        .astype(np.float64)
+    mappers = find_bin_mappers(X, max_bin=600, min_data_in_bin=1)
+    used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+    assert max(mappers[f].num_bin for f in used) > 256
+    _device_vs_host(X, mappers, dtype=np.uint16, transposed=False)
+
+
+def test_f32_exclusive_bounds_edge():
+    """The boundary trick itself: a float64 bound that is NOT f32-
+    representable must bin every f32 value exactly as the f64 compare
+    does — including the f32 neighbors bracketing the bound."""
+    from lightgbm_tpu.ops.ingest import _f32_exclusive
+    b64 = np.float64(0.1) + 1e-12        # not f32-representable
+    lo = np.float32(b64)                 # f32 just below/at
+    hi = np.nextafter(lo, np.float32(np.inf), dtype=np.float32)
+    m = BinMapper(bin_type="numerical", num_bin=2, missing_type="none",
+                  bin_upper_bound=np.array([b64, np.inf]))
+    for v in (lo, hi, np.float32(0.0), np.float32(1.0)):
+        host = m.values_to_bins(np.array([np.float64(v)]))[0]
+        excl = _f32_exclusive(m.bin_upper_bound)
+        dev = int(np.searchsorted(excl, np.float32(v), side="right"))
+        dev = min(dev, len(m.bin_upper_bound) - 1)
+        assert dev == host, (v, host, dev)
+
+
+# ---------------------------------------------------------------------------
+# 2. Dataset-level wiring
+# ---------------------------------------------------------------------------
+
+def _mk_ds(X, y, dev, **extra):
+    p = {"tpu_ingest_device": dev, **extra}
+    return lgb.Dataset(X, label=y, params=p)
+
+
+def test_dataset_device_resident_lazy_host():
+    X = _f32_matrix(4096, 5, seed=11)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = _mk_ds(X, y, "true").construct()
+    assert ds.device_ingested() is not None
+    assert ds._binned is None            # host copy NOT materialized
+    assert ds.binned_dtype() == np.uint8  # ...and dtype probe keeps it so
+    assert ds._binned is None
+    host = _mk_ds(X, y, "false").construct().binned
+    np.testing.assert_array_equal(ds.binned, host)   # lazy materialize
+
+
+def test_device_data_layouts_match_host_upload():
+    from lightgbm_tpu.boosting.gbdt import _DeviceData
+    X = _f32_matrix(3000, 6, seed=12, nan_cols=(1,))
+    y = (X[:, 0] > 0).astype(np.float64)
+    dd_dev = _DeviceData(_mk_ds(X, y, "true").construct(), 512, None,
+                         transposed=True)
+    dd_host = _DeviceData(_mk_ds(X, y, "false").construct(), 512, None,
+                          transposed=True)
+    np.testing.assert_array_equal(np.asarray(dd_dev.bins),
+                                  np.asarray(dd_host.bins))
+    assert dd_dev.bins_t.dtype == np.int8
+    np.testing.assert_array_equal(np.asarray(dd_dev.bins_t),
+                                  np.asarray(dd_host.bins_t))
+
+
+def test_train_bit_identical_and_subset():
+    X = _f32_matrix(4000, 8, seed=13, nan_cols=(2,), cat_cols=(6,))
+    rng = np.random.default_rng(13)
+    y = (X[:, 0] + rng.normal(size=len(X)) * 0.3 > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    models = {}
+    for dev in ("false", "true"):
+        ds = lgb.Dataset(X, label=y, categorical_feature=[6],
+                         params={"tpu_ingest_device": dev})
+        bst = lgb.train({**params, "tpu_ingest_device": dev}, ds,
+                        num_boost_round=6)
+        models[dev] = bst.model_to_string()
+    assert models["true"] == models["false"]
+    # subset (cv fold path) materializes the host copy lazily
+    ds = _mk_ds(X, y, "true").construct()
+    sub = ds.subset(np.arange(0, 4000, 3))
+    assert sub.binned.shape[0] == len(np.arange(0, 4000, 3))
+
+
+def test_training_never_materializes_host_copy():
+    # the full train path — including the default-on EFB bundle probe —
+    # must leave a device-resident dataset device-resident: the lazy
+    # host copy stays unmaterialized for the whole run
+    X = _f32_matrix(4003, 6, seed=23, nan_cols=(1,))
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = _mk_ds(X, y, "true")
+    lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+               "tpu_ingest_device": "true"}, ds, num_boost_round=2)
+    assert ds.device_ingested() is not None
+    assert ds._binned is None
+
+
+def test_tristate_spellings_shared_accept_list():
+    # Config validation and the Dataset-side gate accept the same
+    # spellings: 'on'/'1'/'yes' == true, 'off'/'0'/'no' == false
+    from lightgbm_tpu.config import Config, coerce_tristate
+    assert coerce_tristate("on") == "true"
+    assert coerce_tristate("OFF") == "false"
+    assert coerce_tristate(True) == "true"
+    cfg = Config({"tpu_ingest_device": "on", "tpu_streaming": "0"})
+    assert cfg.tpu_ingest_device == "true"
+    assert cfg.tpu_streaming == "false"
+    X = _f32_matrix(1031, 3, seed=24)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y,
+                     params={"tpu_ingest_device": "on",
+                             "verbosity": -1}).construct()
+    assert ds.device_ingested() is not None
+
+
+# ---------------------------------------------------------------------------
+# 3. warm start: second same-shape construct compiles nothing
+# ---------------------------------------------------------------------------
+
+def test_second_construct_zero_compiles():
+    X = _f32_matrix(4096, 5, seed=14, nan_cols=(1,))
+    y = (X[:, 0] > 0).astype(np.float64)
+    _mk_ds(X, y, "true").construct()     # cold: compiles the kernel
+    progs = ingest_program_cache_size()
+    assert progs >= 1
+    with CompileWatch("second construct") as w:
+        ds2 = _mk_ds(X, y, "true").construct()
+        np.asarray(ds2.device_ingested().bins)[0]  # force execution
+    w.assert_compiles(0)
+    assert ingest_program_cache_size() == progs
+
+
+def test_second_construct_and_engine_init_zero_compiles():
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    X = _f32_matrix(4096, 5, seed=15)
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "tree_learner": "serial", "tpu_ingest_device": "true"}
+    GBDT(Config(params), _mk_ds(X, y, "true"))      # cold
+    with CompileWatch("construct+init") as w:
+        GBDT(Config(params), _mk_ds(X, y, "true"))  # same shapes
+    w.assert_compiles(0)
+
+
+def test_chunking_is_shape_stable():
+    """Different row counts with the same chunk size reuse one compiled
+    program (the padded fixed-shape chunk contract)."""
+    mappers = find_bin_mappers(_f32_matrix(2048, 4, seed=16), max_bin=32)
+    used = list(range(4))
+    before = ingest_program_cache_size()
+    for n in (1500, 2048, 3000):
+        X = _f32_matrix(n, 4, seed=17)
+        res = device_ingest(X, mappers, used, np.uint8, chunk_rows=1024)
+        np.asarray(res.bins)
+    assert ingest_program_cache_size() <= before + 1
+
+
+# ---------------------------------------------------------------------------
+# 4. threaded host fallback
+# ---------------------------------------------------------------------------
+
+def test_threaded_host_fallback_matches_serial(monkeypatch):
+    from lightgbm_tpu.io import binning as binning_mod
+    monkeypatch.setattr(binning_mod, "_native", lambda: None)
+    X = _f32_matrix(250_000, 9, seed=18, nan_cols=(1,), cat_cols=(7,))
+    y = (X[:, 0] > 0).astype(np.float64)
+    serial = lgb.Dataset(X, label=y, categorical_feature=[7],
+                         params={"tpu_ingest_device": "false",
+                                 "tpu_ingest_threads": 1}) \
+        .construct().binned
+    threaded = lgb.Dataset(X, label=y, categorical_feature=[7],
+                           params={"tpu_ingest_device": "false",
+                                   "tpu_ingest_threads": 4}) \
+        .construct().binned
+    np.testing.assert_array_equal(serial, threaded)

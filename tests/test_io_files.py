@@ -306,3 +306,51 @@ def test_cli_distributed_train_uneven_shards(tmp_path):
     assert os.path.exists(model_path)
     bst = lgb.Booster(model_file=model_path)
     assert np.mean((bst.predict(X) > 0.5) == y) > 0.8
+
+
+def test_two_round_streams_peak_rss(tmp_path):
+    """The streamed loader must never hold the full raw float64 matrix:
+    its peak traced allocation while loading has to stay well under
+    both the one-round loader's (which materializes the parse buffer +
+    X) and the raw matrix size itself — the chunked-parse-into-ingest
+    memory contract. tracemalloc (numpy buffers are tracked) gives a
+    deterministic high-water mark where process RSS cannot (the jax
+    import already dwarfs a small load's RSS delta)."""
+    import tracemalloc
+    rng = np.random.default_rng(5)
+    n, f = 250_000, 20
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(float)
+    path = str(tmp_path / "big.csv")
+    try:
+        import pandas as pd
+        cols = {"target": y}
+        cols.update({f"f{i}": X[:, i] for i in range(f)})
+        pd.DataFrame(cols).to_csv(path, index=False,
+                                  float_format="%.8g")
+    except ImportError:
+        _write_csv(path, X, y)
+    del X, y
+    raw_bytes = n * f * 8
+
+    def peak_load(stream: bool) -> tuple:
+        params = {"max_bin": 63, "bin_construct_sample_cnt": 20000,
+                  "verbosity": -1}
+        if stream:
+            params.update({"two_round": True,
+                           "tpu_stream_chunk_rows": 20000})
+        tracemalloc.start()
+        try:
+            ds = lgb.Dataset(path, params=params).construct()
+            peak = tracemalloc.get_traced_memory()[1]
+        finally:
+            tracemalloc.stop()
+        return peak, ds.num_data
+
+    one_peak, n_one = peak_load(stream=False)
+    stream_peak, n_stream = peak_load(stream=True)
+    assert n_one == n_stream == n
+    # the streamed path must beat one-round by a wide margin AND stay
+    # below the raw matrix size itself (chunk + binned + sample pool)
+    assert stream_peak < 0.6 * one_peak, (stream_peak, one_peak)
+    assert stream_peak < raw_bytes, (stream_peak, raw_bytes)
