@@ -120,6 +120,17 @@ def peak_hbm_gib():
         return None
 
 
+def _snap_gauge(snap, name):
+    """Read one gauge value back out of an obs snapshot dict (the
+    metric line below is composed from the SNAPSHOT, not from local
+    variables, so the numbers in BENCH_*.json and in --metrics-json can
+    never disagree)."""
+    for m in snap["metrics"]:
+        if m["name"] == name and not m.get("labels"):
+            return m.get("value")
+    return None
+
+
 def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
                cat_features="auto", measure_predict=True):
     """Train warmup+iters rounds, AUC there, then median of N timed
@@ -235,6 +246,10 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="pre-snapshot gate mode (scripts/check.sh): "
                          "single window, skip plain1m + guard2")
+    ap.add_argument("--metrics-json", type=str, default="",
+                    help="append one obs metrics-snapshot JSONL line "
+                         "(docs/observability.md schema) to PATH; also "
+                         "enables tpu_metrics collection for the run")
     args = ap.parse_args()
     if args.smoke:
         args.windows = 1
@@ -266,17 +281,24 @@ def main():
                                        else "false")
     if args.compile_cache:
         params["tpu_compile_cache_dir"] = args.compile_cache
+    from lightgbm_tpu import obs
+    if args.metrics_json:
+        obs.enable(metrics=True)
 
     ips, auc, bin_time, predict_rps = run_config(X, y, X_ho, y_ho,
                                                  params, args.iters,
                                                  args.warmup,
                                                  args.windows)
-
-    extras = "; goss" if args.goss else "; full-rows"
-    if args.quant:
-        extras += "+quantized"
-    extras += f"; median-of-{args.windows}"
-    extras += f"; predict_rps={predict_rps:.0f}"
+    # headline measurements become forced obs gauges, and the metric
+    # line below reads them back from ONE snapshot — the snapshot is
+    # the authority, the printed line a view of it (same keys as ever,
+    # so BENCH_*.json parsing is unchanged)
+    obs.set_gauge("bench.iters_per_sec", ips, force=True)
+    obs.set_gauge("bench.holdout_auc", auc, force=True)
+    obs.set_gauge("bench.construct_s", bin_time[0], force=True)
+    obs.set_gauge("bench.engine_init_s", bin_time[1], force=True)
+    obs.set_gauge("bench.ttfi_s", bin_time[2], force=True)
+    obs.set_gauge("bench.predict_rps", predict_rps, force=True)
 
     # continuity figure: the rounds-1..3 headline config (higgs-1M,
     # plain full-row f32) timed in the same process on the main run's
@@ -292,7 +314,8 @@ def main():
         ips1, auc1, _, _ = run_config(
             X[:n1], y[:n1], X_ho[:100_000], y_ho[:100_000], p1,
             40, 50, windows=3, measure_predict=False)
-        extras += f"; plain1m={ips1:.2f}@auc{auc1:.4f}(median-of-3)"
+        obs.set_gauge("bench.plain1m_iters_per_sec", ips1, force=True)
+        obs.set_gauge("bench.plain1m_auc", auc1, force=True)
 
     # categorical/NaN/interaction guard (see module docstring)
     if args.guard2:
@@ -304,13 +327,39 @@ def main():
                                         10, 40, windows=1,
                                         cat_features=[10, 11],
                                         measure_predict=False)
-        extras += f"; guard2_auc={g_auc:.4f}"
-        if g_auc < 0.85:
-            extras += " GUARD2_BELOW_FLOOR(0.85)"
+        obs.set_gauge("bench.guard2_auc", g_auc, force=True)
 
     peak = peak_hbm_gib()
     if peak is not None:
-        extras += f"; peak_hbm_gib={peak}"
+        obs.set_gauge("bench.peak_hbm_gib", peak, force=True)
+
+    # ONE snapshot is the source for the metric line, the optional
+    # JSONL dump, and (with tpu_metrics on) the full phase-timer /
+    # cache-hit / compile-gauge picture of the run
+    snap = obs.snapshot()
+    if args.metrics_json:
+        obs.dump_jsonl(args.metrics_json, snap)
+
+    ips = _snap_gauge(snap, "bench.iters_per_sec")
+    extras = "; goss" if args.goss else "; full-rows"
+    if args.quant:
+        extras += "+quantized"
+    extras += f"; median-of-{args.windows}"
+    extras += (f"; predict_rps="
+               f"{_snap_gauge(snap, 'bench.predict_rps'):.0f}")
+    v = _snap_gauge(snap, "bench.plain1m_iters_per_sec")
+    if v is not None:
+        extras += (f"; plain1m={v:.2f}@auc"
+                   f"{_snap_gauge(snap, 'bench.plain1m_auc'):.4f}"
+                   f"(median-of-3)")
+    v = _snap_gauge(snap, "bench.guard2_auc")
+    if v is not None:
+        extras += f"; guard2_auc={v:.4f}"
+        if v < 0.85:
+            extras += " GUARD2_BELOW_FLOOR(0.85)"
+    v = _snap_gauge(snap, "bench.peak_hbm_gib")
+    if v is not None:
+        extras += f"; peak_hbm_gib={v}"
     shape_tag = ("higgs1m-synth" if args.rows == 1_000_000
                  else f"higgs{args.rows // 1_000_000}m-synth"
                  if args.rows % 1_000_000 == 0
@@ -321,10 +370,14 @@ def main():
     result = {
         "metric": ("boosting_iters_per_sec "
                    f"({shape_tag} nl={NUM_LEAVES} mb={MAX_BIN}; "
-                   f"holdout_auc={auc:.4f}@{args.warmup + args.iters}"
-                   f"rounds; construct_s={bin_time[0]:.1f}; "
-                   f"engine_init_s={bin_time[1]:.1f}; "
-                   f"ttfi_s={bin_time[2]:.1f}{extras})"),
+                   f"holdout_auc="
+                   f"{_snap_gauge(snap, 'bench.holdout_auc'):.4f}"
+                   f"@{args.warmup + args.iters}rounds; construct_s="
+                   f"{_snap_gauge(snap, 'bench.construct_s'):.1f}; "
+                   f"engine_init_s="
+                   f"{_snap_gauge(snap, 'bench.engine_init_s'):.1f}; "
+                   f"ttfi_s={_snap_gauge(snap, 'bench.ttfi_s'):.1f}"
+                   f"{extras})"),
         "value": round(ips, 4),
         "unit": "iters/sec",
         "vs_baseline": round(ips / base, 4),

@@ -93,7 +93,16 @@ def main():
     ap.add_argument("--compare", action="store_true",
                     help="print a device-vs-best-host speedup line per "
                          "cell")
+    ap.add_argument("--metrics-json", type=str, default="",
+                    help="append one obs metrics-snapshot JSONL line "
+                         "(docs/observability.md schema) to PATH; also "
+                         "enables tpu_metrics for the run, so the "
+                         "snapshot carries ingest H2D-bytes/chunk "
+                         "counters and construct timings")
     args = ap.parse_args()
+    from lightgbm_tpu import obs
+    if args.metrics_json:
+        obs.enable(metrics=True)
     from lightgbm_tpu.io.binning import find_bin_mappers
 
     rows_list = [int(r) for r in args.rows.split(",")]
@@ -132,9 +141,19 @@ def main():
                             "speedup_device_vs_best_host":
                                 round(ratio, 2),
                             "best_host": best_host}), flush=True)
+    # aggregate from an obs snapshot (authoritative; --metrics-json
+    # dumps the same one)
+    if best_speedup is not None:
+        obs.set_gauge("bench.ingest_speedup_best",
+                      round(best_speedup, 2), force=True)
+    snap = obs.snapshot()
+    if args.metrics_json:
+        obs.dump_jsonl(args.metrics_json, snap)
     if args.compare and best_speedup is not None:
+        val = next(m["value"] for m in snap["metrics"]
+                   if m["name"] == "bench.ingest_speedup_best")
         print(json.dumps({"metric": "ingest_speedup_best",
-                          "value": round(best_speedup, 2)}))
+                          "value": val}))
 
 
 if __name__ == "__main__":

@@ -84,7 +84,16 @@ def main():
     ap.add_argument("--compare", action="store_true",
                     help="bench BOTH paths on one trained model and "
                          "report per-cell speedup ratios")
+    ap.add_argument("--metrics-json", type=str, default="",
+                    help="append one obs metrics-snapshot JSONL line "
+                         "(docs/observability.md schema) to PATH; also "
+                         "enables tpu_metrics for the run, so the "
+                         "snapshot carries the predict latency "
+                         "histograms and cache-hit counters")
     args = ap.parse_args()
+    from lightgbm_tpu import obs
+    if args.metrics_json:
+        obs.enable(metrics=True)
     trees_list = [int(t) for t in args.trees.split(",")]
     batches = [int(b) for b in args.batches.split(",")]
     paths = ([False, True] if args.compare
@@ -118,9 +127,18 @@ def main():
                                   round(ratio, 2)}), flush=True)
         print(json.dumps({"trees": trees, "train_s": round(train_s, 1)}),
               flush=True)
+    # the aggregate line reads from an obs snapshot (the snapshot is
+    # authoritative; --metrics-json dumps the same one)
     best = max(results, key=lambda r: r["steady_rows_per_sec"])
+    obs.set_gauge("bench.predict_rows_per_sec_best",
+                  best["steady_rows_per_sec"], force=True)
+    snap = obs.snapshot()
+    if args.metrics_json:
+        obs.dump_jsonl(args.metrics_json, snap)
+    val = next(m["value"] for m in snap["metrics"]
+               if m["name"] == "bench.predict_rows_per_sec_best")
     print(json.dumps({"metric": "predict_rows_per_sec_best",
-                      "value": best["steady_rows_per_sec"],
+                      "value": val,
                       "path": best["path"]}))
 
 

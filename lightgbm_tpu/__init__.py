@@ -7,9 +7,11 @@ matmuls on the MXU, leaf-wise growth as a jitted while_loop, per-row
 leaf-id partitioning, and mesh collectives (psum/psum_scatter/all_gather)
 in place of the reference's socket/MPI/NCCL distributed learners.
 """
+from . import obs
 from .basic import Booster, Dataset, LightGBMError
 from .callback import (EarlyStopException, checkpoint, early_stopping,
-                       log_evaluation, record_evaluation, reset_parameter)
+                       log_evaluation, record_evaluation,
+                       record_metrics, reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
 
@@ -19,8 +21,8 @@ __all__ = [
     "Booster", "Dataset", "LightGBMError", "Config",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException", "checkpoint",
-    "CheckpointManager", "CheckpointError",
+    "record_metrics", "reset_parameter", "EarlyStopException",
+    "checkpoint", "CheckpointManager", "CheckpointError", "obs",
 ]
 
 

@@ -222,6 +222,43 @@ def run(argv: Optional[List[str]] = None) -> int:
                       f"(only cpp is supported)")
         return 0
 
+    if task == "dump_metrics":
+        # observability hook (docs/observability.md): render a metrics
+        # snapshot as Prometheus-style text (default) or pretty JSON.
+        # data=FILE reads the newest line of a tpu_metrics_dump JSONL;
+        # without data= the LIVE process registry is dumped (useful
+        # when chained programmatically, mostly empty from a fresh CLI)
+        import json
+        from . import obs
+        from .obs.metrics import prometheus_from_snapshot
+        if data_path:
+            try:
+                with open(data_path) as f:
+                    lines = [ln for ln in f.read().splitlines()
+                             if ln.strip()]
+            except OSError as e:
+                log.fatal(f"task=dump_metrics: cannot read "
+                          f"{data_path}: {e}")
+            if not lines:
+                log.fatal(f"task=dump_metrics: {data_path} holds no "
+                          f"snapshot lines")
+            try:
+                snap = json.loads(lines[-1])
+            except ValueError as e:
+                log.fatal(f"task=dump_metrics: {data_path} last line "
+                          f"is not valid JSON: {e}")
+        else:
+            snap = obs.snapshot()
+        fmt = str(params.get("format", "prometheus")).lower()
+        if fmt in ("prometheus", "prom", "text"):
+            sys.stdout.write(prometheus_from_snapshot(snap))
+        elif fmt == "json":
+            sys.stdout.write(json.dumps(snap, indent=2) + "\n")
+        else:
+            log.fatal(f"task=dump_metrics: unknown format {fmt!r} "
+                      f"(prometheus or json)")
+        return 0
+
     if task == "save_binary":
         if data_path is None:
             log.fatal("task=save_binary needs data=FILE")

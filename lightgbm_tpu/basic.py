@@ -75,6 +75,21 @@ class Booster:
                                 "(loaded from model file)")
         return self._engine
 
+    def metrics(self) -> Dict[str, Any]:
+        """Current observability snapshot (docs/observability.md):
+        counters / gauges / histograms from the process-wide registry,
+        with the device/compile gauges refreshed. Collection is off by
+        default — enable with ``tpu_metrics=true`` (or
+        ``lightgbm_tpu.obs.enable()``), else the snapshot is empty or
+        partial."""
+        from . import obs
+        if self._engine is not None and hasattr(self._engine,
+                                                "metrics_snapshot"):
+            return self._engine.metrics_snapshot()
+        # no engine (model-file booster) or an engine without the API
+        # (StreamingGBDT): the registry is process-wide anyway
+        return obs.snapshot()
+
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         self.engine.add_valid(data, name)
         if not hasattr(self, "_valid_sets"):

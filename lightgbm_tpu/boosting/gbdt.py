@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..config import Config
 from ..io.dataset import Dataset
 from ..learner.serial import GrowConfig, grow_tree
@@ -165,6 +166,12 @@ class _DeviceData:
             # over every mesh device either way
             n_dev = mesh.devices.size if mesh is not None else 1
             per_dev = need // n_dev
+            if obs.enabled():
+                # the capacity-guard estimate as a gauge: HBM creep
+                # shows as hbm.binned_estimate_bytes vs hbm.bytes_limit
+                # trending together, not as a surprise fatal
+                obs.set_gauge("hbm.binned_estimate_bytes", per_dev)
+                obs.set_gauge("hbm.bytes_limit", hbm_limit)
             if per_dev > ENGINE_HBM_FRACTION * hbm_limit:
                 from ..utils import log as _log
                 _log.fatal(
@@ -1818,39 +1825,44 @@ class GBDT:
                     log.fatal(f"tpu_debug at iteration {self.iter_}: "
                               f"{e}")
         cegb_U_new = None
-        if grad is not None:
-            mask_gh, mask_count = self._bagging_masks()
-            g = self._pad_custom(grad)
-            h = self._pad_custom(hess)
-            stacked, leaf_ids, new_score, cegb_U_new = \
-                self._step_custom(
-                    self.score, g, h, mask_gh, mask_count, allowed,
-                    self._cegb_pen(), key)
-        elif goss_active:
-            if self._pos_state is not None:
-                stacked, leaf_ids, new_score, self._pos_state = \
-                    self._step_goss_state(self.score, allowed,
-                                          self._cegb_pen(), key,
-                                          self._pos_state)
-            elif self._step_goss_compact is not None:
+        # the fused XLA step dispatch (gradients + grow + split + score
+        # apply run as ONE device program, so the host can only time
+        # the dispatch boundary; completion lands in train/fetch_trees
+        # where the tree arrays materialize)
+        with obs.span("train/step", iteration=self.iter_):
+            if grad is not None:
+                mask_gh, mask_count = self._bagging_masks()
+                g = self._pad_custom(grad)
+                h = self._pad_custom(hess)
                 stacked, leaf_ids, new_score, cegb_U_new = \
-                    self._step_goss_compact(
-                        self.score, allowed, self._cegb_pen(), key)
+                    self._step_custom(
+                        self.score, g, h, mask_gh, mask_count, allowed,
+                        self._cegb_pen(), key)
+            elif goss_active:
+                if self._pos_state is not None:
+                    stacked, leaf_ids, new_score, self._pos_state = \
+                        self._step_goss_state(self.score, allowed,
+                                              self._cegb_pen(), key,
+                                              self._pos_state)
+                elif self._step_goss_compact is not None:
+                    stacked, leaf_ids, new_score, cegb_U_new = \
+                        self._step_goss_compact(
+                            self.score, allowed, self._cegb_pen(), key)
+                else:
+                    stacked, leaf_ids, new_score, cegb_U_new = \
+                        self._step_goss(
+                            self.score, allowed, self._cegb_pen(), key)
             else:
-                stacked, leaf_ids, new_score, cegb_U_new = \
-                    self._step_goss(
-                        self.score, allowed, self._cegb_pen(), key)
-        else:
-            mask_gh, mask_count = self._bagging_masks()
-            if self._pos_state is not None:
-                stacked, leaf_ids, new_score, self._pos_state = \
-                    self._step_state(self.score, mask_gh, mask_count,
-                                     allowed, self._cegb_pen(), key,
-                                     self._pos_state)
-            else:
-                stacked, leaf_ids, new_score, cegb_U_new = self._step(
-                    self.score, mask_gh, mask_count, allowed,
-                    self._cegb_pen(), key)
+                mask_gh, mask_count = self._bagging_masks()
+                if self._pos_state is not None:
+                    stacked, leaf_ids, new_score, self._pos_state = \
+                        self._step_state(self.score, mask_gh, mask_count,
+                                         allowed, self._cegb_pen(), key,
+                                         self._pos_state)
+                else:
+                    stacked, leaf_ids, new_score, cegb_U_new = self._step(
+                        self.score, mask_gh, mask_count, allowed,
+                        self._cegb_pen(), key)
         # start device->host copies of the (tiny) tree arrays immediately:
         # over a tunneled TPU each sync transfer is a latency round-trip,
         # so issue them all async and overlap with the step itself
@@ -1880,9 +1892,13 @@ class GBDT:
                                             renewed_dev)
         self.score = new_score
         if self.valid_scores:
-            self.valid_scores = self._valid_update(self.valid_scores,
-                                                   stacked)
-        self._append_host_trees(self._fetch_tree_arrays(stacked))
+            with obs.span("train/valid_update"):
+                self.valid_scores = self._valid_update(self.valid_scores,
+                                                       stacked)
+        with obs.span("train/fetch_trees"):
+            host_trees = self._fetch_tree_arrays(stacked)
+        self._append_host_trees(host_trees)
+        obs.inc("train.iterations")
         if cegb_U_new is not None:
             # device-side acquisition fold already ran inside the step
             # (_cegb_u_fold): in-sample rows acquired their leaf-path
@@ -2066,13 +2082,17 @@ class GBDT:
             keys = jnp.asarray(np.stack(
                 [hi, (seeds64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
                 axis=1))
-            new_score, stacked = self._chunk_cache[goss_now](
-                self.score, keys)
-            self.score = new_score
-            host = self._fetch_tree_arrays(stacked)
-            for i in range(n):
-                self._append_host_trees(
-                    {kk: v[i] for kk, v in host.items()})
+            with obs.span("train/fused_chunk", iterations=n,
+                          start=it0):
+                new_score, stacked = self._chunk_cache[goss_now](
+                    self.score, keys)
+                self.score = new_score
+                with obs.span("train/fetch_trees"):
+                    host = self._fetch_tree_arrays(stacked)
+                for i in range(n):
+                    self._append_host_trees(
+                        {kk: v[i] for kk, v in host.items()})
+            obs.inc("train.iterations", n)
             self.iter_ += n
             done += n
 
@@ -2288,10 +2308,12 @@ class GBDT:
                         cache[1][key] = cache[1].pop(key)
                     except KeyError:
                         pass
+                    obs.inc("predict.stack_cache_hits")
                     return hit
         # observable for the zero-restack serving guarantee (tests pin
         # that warm predicts never reach this point)
         self._stack_builds = getattr(self, "_stack_builds", 0) + 1
+        obs.inc("predict.stack_cache_misses")
         trees = [self.models[i] for i in indices]
         n_real = len(trees)
         n_pad = max(pad_count, n_real)
@@ -2384,6 +2406,26 @@ class GBDT:
         parallel_trees`` / ``tpu_predict_buckets`` /
         ``tpu_predict_chunk_rows`` tune one call without mutating the
         engine config."""
+        if not obs.any_enabled():
+            return self._predict_impl(X, raw_score, start_iteration,
+                                      num_iteration, pred_leaf,
+                                      **overrides)
+        try:
+            n_rows = int(X.shape[0])
+        except Exception:
+            n_rows = len(X) if hasattr(X, "__len__") else 0
+        with obs.span("predict/call", rows=n_rows):
+            out = self._predict_impl(X, raw_score, start_iteration,
+                                     num_iteration, pred_leaf,
+                                     **overrides)
+        obs.inc("predict.requests")
+        obs.inc("predict.rows", n_rows)
+        return out
+
+    def _predict_impl(self, X: np.ndarray, raw_score: bool = False,
+                      start_iteration: int = 0, num_iteration: int = -1,
+                      pred_leaf: bool = False,
+                      **overrides) -> np.ndarray:
         if self.linear_tree:
             # linear leaves need raw feature values — host-model path
             # (cached; the model list only grows)
@@ -2546,6 +2588,13 @@ class GBDT:
             if leaves_dev is not None:
                 leaf_parts.append(np.asarray(leaves_dev)[:, :rows])
 
+        if obs.enabled():
+            # bucket/chunk accounting: padded rows quantify the cost of
+            # the bounded-compile-cache guarantee, chunk count the
+            # streaming fan-out
+            obs.inc("predict.chunks", len(plan))
+            obs.inc("predict.padded_rows",
+                    sum(p - r for _s, r, p in plan))
         pending: List[tuple] = []
         for start, rows, pad_to in plan:
             blk = bins[start:start + rows]
@@ -2581,3 +2630,11 @@ class GBDT:
 
     def num_trees(self) -> int:
         return len(self.models)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Current observability snapshot (docs/observability.md):
+        process-wide metrics registry contents with the device/compile
+        gauges refreshed. Enable collection with ``tpu_metrics=true``
+        (off by default, so an un-enabled engine returns an empty or
+        partial snapshot)."""
+        return obs.snapshot()

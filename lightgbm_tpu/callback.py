@@ -177,6 +177,41 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     return _callback
 
 
+def record_metrics(sink, period: int = 1) -> Callable:
+    """Per-round observability sink (docs/observability.md): every
+    ``period`` iterations, hand the current metrics snapshot to the
+    user. ``sink`` is either a list (snapshots are appended, each
+    tagged with its iteration) or a callable invoked as
+    ``sink(env, snapshot)``.
+
+    Constructing the callback turns the metrics pillar on — asking for
+    per-round snapshots IS opting in (same contract as
+    ``tpu_metrics=true``). Device/compile gauges are NOT refreshed per
+    round (that would add a device sync to every iteration); the final
+    snapshot from ``Booster.metrics()`` / ``tpu_metrics_dump`` carries
+    current ones.
+    """
+    from . import obs
+    obs.enable(metrics=True)
+    if not callable(sink) and not isinstance(sink, list):
+        raise TypeError("record_metrics sink should be a list or a "
+                        "callable")
+
+    def _callback(env: CallbackEnv) -> None:
+        if period <= 0 or (env.iteration + 1) % period != 0:
+            return
+        snap = obs.snapshot(refresh_device=False)
+        if callable(sink):
+            sink(env, snap)
+        else:
+            snap["iteration"] = env.iteration
+            sink.append(snap)
+    # after evaluation/early-stop bookkeeping so the snapshot reflects
+    # the completed round
+    _callback.order = 35
+    return _callback
+
+
 def checkpoint(checkpoint_dir: str, interval: int = 1, keep_n: int = 3,
                manager=None) -> Callable:
     """Durable-checkpoint callback: every ``interval`` iterations,
@@ -221,6 +256,7 @@ def checkpoint(checkpoint_dir: str, interval: int = 1, keep_n: int = 3,
         # engine's host trees from the exact pickled copies in the
         # engine state instead — model text rounds internal_value/
         # leaf_weight through "{:g}", which is not bit-exact
+        from . import obs
         state = {
             "version": 1,
             "iteration": it,
@@ -232,6 +268,11 @@ def checkpoint(checkpoint_dir: str, interval: int = 1, keep_n: int = 3,
                 "best_score": {k: dict(v)
                                for k, v in model.best_score.items()},
             },
+            # metrics ride along so a resumed run CONTINUES the
+            # interrupted run's counters/histograms instead of
+            # restarting them at zero (engine.train imports this on
+            # resume_from; docs/observability.md)
+            "obs": obs.export_state(),
         }
         mgr.save(state, it)
 

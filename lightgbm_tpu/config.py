@@ -324,6 +324,23 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # TensorBoard / xprof) — the §5 tracing subsystem; the reference's
     # analog is the global function timers + GPU_DEBUG timing
     "tpu_profile_dir": _P("str", ""),
+    # ---- observability subsystem (lightgbm_tpu/obs/;
+    # docs/observability.md) -------------------------------------------
+    # structured metrics: per-round phase timers, predict latency
+    # histograms, cache-hit counters, compile/HBM gauges — read them
+    # via Booster.metrics(), tpu_metrics_dump, or task=dump_metrics.
+    # Off by default (~zero overhead off; <3% on when enabled)
+    "tpu_metrics": _P("bool", False),
+    # host-span tracing: write a Chrome-trace JSON (open in Perfetto /
+    # chrome://tracing) of the nested obs spans — round loop, predict
+    # chunks, ingest streaming, checkpoint writes — to this directory
+    # at the end of training. Complements tpu_profile_dir (device-side
+    # xprof) with the host orchestration view
+    "tpu_trace_dir": _P("str", ""),
+    # append one JSONL metrics-snapshot line to this path when
+    # training finishes (implies tpu_metrics); the same schema
+    # bench.py --metrics-json and scripts/check.sh consume
+    "tpu_metrics_dump": _P("str", ""),
     # ---- serving fast path (ops/predict.py + GBDT.predict) -----------
     # level-synchronous tree-parallel forest traversal: all T trees
     # advance one level per step as one batched MXU contraction (or a
@@ -603,6 +620,10 @@ class Config:
         self.tpu_ingest_device = coerce_tristate(self.tpu_ingest_device,
                                                  "tpu_ingest_device")
         setup_compile_cache(self.tpu_compile_cache_dir)
+        # observability knobs engage process-wide (enable-only: the 2-3
+        # Config objects one train() builds must not flip it back off)
+        from . import obs
+        obs.configure_from_config(self)
         for m in (self.monotone_constraints or []):
             if int(m) not in (-1, 0, 1):
                 log.fatal("monotone_constraints must be -1, 0 or 1, "

@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 from . import callback as callback_mod
+from . import obs
 from .basic import Booster, Dataset
 from .config import Config
 from .utils import log
@@ -93,8 +94,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         else:
             init_forest = init_model
 
-    from .utils.timer import log_timers, timed
-    with timed("dataset construction + engine build"):
+    with obs.span("train/setup"):
         booster = Booster(params=params, train_set=train_set,
                           init_forest=init_forest)
     if valid_sets:
@@ -150,6 +150,35 @@ def train(params: Dict[str, Any], train_set: Dataset,
             if key and key in cb_states and hasattr(cb, "set_state"):
                 cb.set_state(cb_states[key])
         start_iter = eng.iter_
+        # metrics survive checkpoint/restore: adopt the interrupted
+        # run's registry state, then count this resume (the restart
+        # counter keeps incrementing across resume_from cycles). Only
+        # when the metrics pillar is on — a resume with tpu_metrics off
+        # must leave the registry as empty as any other disabled run.
+        # The checkpoint LOAD just above already recorded this
+        # process's restore count/duration; the saved state predates
+        # that restore, so fold the live values back on top of it
+        # (count==1 on this path makes the histogram re-observe exact)
+        if obs.enabled():
+            saved = resume_state.get("obs") or {}
+            saved_names = {m.get("name")
+                           for m in saved.get("metrics", [])}
+            live_restores = obs.registry().get("checkpoint.restores")
+            live_restores = getattr(live_restores, "value", 0.0)
+            live_hist = obs.registry().get("checkpoint/restore")
+            live_obs = ([live_hist.sum / live_hist.count]
+                        * live_hist.count if live_hist else [])
+            obs.import_state(saved)
+            # fold back ONLY what the import actually overwrote — a
+            # first resume's saved state lacks these metrics, so the
+            # live values survived the import untouched
+            if "checkpoint.restores" in saved_names and live_restores:
+                obs.counter("checkpoint.restores").inc(live_restores)
+            if "checkpoint/restore" in saved_names:
+                for dur in live_obs:
+                    obs.registry().histogram("checkpoint/restore") \
+                        .observe(dur)
+        obs.inc("train.resumes", force=True)
         log.info(f"resumed training from checkpoint "
                  f"{resume_state.get('_checkpoint_path', '?')} at "
                  f"iteration {start_iter}")
@@ -177,6 +206,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # (tpu_profile_dir; SURVEY.md §5 tracing subsystem)
     import contextlib
     with contextlib.ExitStack() as _prof_stack:
+        # registered FIRST so it runs on EVERY exit — including a
+        # raising iteration: a crashed run must still write the metrics
+        # snapshot / Chrome trace the config asked for (those artifacts
+        # matter MOST on the runs that die)
+        _prof_stack.callback(_finish_train_obs, cfg)
         if cfg.tpu_profile_dir:
             import jax
             jax.profiler.start_trace(cfg.tpu_profile_dir)
@@ -188,10 +222,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 and not cfg.is_provide_training_metric and fobj is None
                 and cfg.tpu_fuse_iters > 1 and cfg.snapshot_freq <= 0
                 and booster.engine.can_fuse_iters()):
-            with timed("boosting (fused chunks)"):
+            with obs.span("train/fused",
+                          rounds=num_boost_round - start_iter):
                 booster.engine.train_chunk(num_boost_round - start_iter)
             booster.best_iteration = booster.current_iteration()
-            log_timers()
             return booster
 
         for it in range(start_iter, num_boost_round):
@@ -201,37 +235,50 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 evaluation_result_list=None)
             for cb in callbacks_before:
                 cb(env_pre)
-            with timed("boosting (per-iter)"):
-                booster.update(fobj=fobj)
-            if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
-                # mid-training checkpoint (Application snapshot_freq semantics)
-                booster.save_model(
-                    f"{cfg.output_model}.snapshot_iter_{it + 1}")
+            with obs.span("train/round", round=it):
+                with obs.span("train/update"):
+                    booster.update(fobj=fobj)
+                if cfg.snapshot_freq > 0 \
+                        and (it + 1) % cfg.snapshot_freq == 0:
+                    # mid-training checkpoint (Application snapshot_freq
+                    # semantics)
+                    booster.save_model(
+                        f"{cfg.output_model}.snapshot_iter_{it + 1}")
 
-            eval_results = []
-            should_eval = ((booster.engine.valid_data or train_as_valid
-                            or cfg.is_provide_training_metric)
-                           and (it + 1) % cfg.metric_freq == 0)
-            if should_eval:
-                if cfg.is_provide_training_metric or train_as_valid:
-                    eval_results.extend(booster.eval_train(feval))
-                eval_results.extend(booster.eval_valid(feval))
-            env = callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=it,
-                begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=eval_results)
-            try:
-                for cb in callbacks_after:
-                    cb(env)
-            except callback_mod.EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                for name, metric, value, _ in (e.best_score or []):
-                    booster.best_score.setdefault(name, {})[metric] = value
-                break
+                eval_results = []
+                should_eval = ((booster.engine.valid_data or train_as_valid
+                                or cfg.is_provide_training_metric)
+                               and (it + 1) % cfg.metric_freq == 0)
+                if should_eval:
+                    with obs.span("train/eval"):
+                        if cfg.is_provide_training_metric or train_as_valid:
+                            eval_results.extend(booster.eval_train(feval))
+                        eval_results.extend(booster.eval_valid(feval))
+                env = callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=it,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=eval_results)
+                try:
+                    for cb in callbacks_after:
+                        cb(env)
+                except callback_mod.EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    for name, metric, value, _ in (e.best_score or []):
+                        booster.best_score.setdefault(name, {})[metric] \
+                            = value
+                    break
         if booster.best_iteration < 0:
             booster.best_iteration = booster.current_iteration()
-        log_timers()
         return booster
+
+
+def _finish_train_obs(cfg: Config) -> None:
+    """End-of-training observability housekeeping: debug-log the span
+    totals (the old timer-table behavior) and write the exports the
+    config asked for (JSONL metrics snapshot, Chrome trace)."""
+    from .utils.timer import log_timers
+    log_timers()
+    obs.flush_from_config(cfg)
 
 
 class CVBooster:

@@ -298,6 +298,11 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
+        from .. import obs
+        with obs.span("dataset/construct"):
+            return self._construct_impl()
+
+    def _construct_impl(self) -> "Dataset":
         # warm-start: point jax's persistent compile cache BEFORE the
         # first construct-time kernel (the ingest assignment jit)
         from ..config import setup_compile_cache

@@ -1,0 +1,254 @@
+"""Observability subsystem: metrics registry + phase-scoped tracing +
+device/compile telemetry (docs/observability.md).
+
+Three pillars, one import:
+
+- **Metrics** (obs/metrics.py): process-wide counters / gauges /
+  histograms with labels, exported as JSONL snapshots
+  (``dump_jsonl``), Prometheus-style text (``prometheus_text``), or
+  the ``Booster.metrics()`` / ``GBDT.metrics_snapshot()`` APIs.
+- **Tracing** (obs/tracing.py): ``with obs.span("train/round",
+  round=i):`` — nested spans that record wall time (plus optional
+  device-synced time) into a Chrome-trace JSON viewable in Perfetto.
+- **Device telemetry** (obs/telemetry.py): compile-request counting,
+  program-cache-size and HBM gauges refreshed into the registry.
+
+OFF BY DEFAULT and engineered for ~zero cost when off: every
+instrumented hot path funnels through :func:`span` / :func:`inc` /
+:func:`observe`, whose disabled path is one bool check and a shared
+no-op context manager — no locks, no clocks, no allocation. Enabled
+via ``Config`` knobs (``tpu_metrics=true``, ``tpu_trace_dir=DIR``,
+``tpu_metrics_dump=PATH``) or programmatically with :func:`enable`.
+
+Cold paths that must record regardless (restart/retry accounting, the
+benches, the utils/timer back-compat shim) pass ``force=True``.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, Optional
+
+from . import metrics as _metrics
+from . import tracing as _tracing
+from .metrics import prometheus_from_snapshot, registry
+from .tracing import (export_chrome_trace, span_stack, trace_dir,
+                      tracing_enabled)
+
+__all__ = [
+    "enable", "disable", "enabled", "any_enabled", "tracing_enabled",
+    "span", "inc", "set_gauge", "observe", "counter", "gauge",
+    "histogram", "registry", "snapshot", "dump_jsonl",
+    "prometheus_text", "prometheus_from_snapshot",
+    "export_chrome_trace", "export_state", "import_state", "reset",
+    "configure_from_config", "flush_from_config", "span_stack",
+    "trace_dir",
+]
+
+
+class _State:
+    __slots__ = ("metrics", "device_time")
+
+    def __init__(self) -> None:
+        self.metrics = False
+        self.device_time = False
+
+
+_state = _State()
+
+# shared no-op context manager for disabled spans: nullcontext is
+# reentrant and reusable, so ONE instance serves every disabled site
+_NULL_CM = contextlib.nullcontext()
+
+
+def enable(metrics: bool = True, trace_dir: Optional[str] = None,
+           trace: Optional[bool] = None,
+           device_time: Optional[bool] = None) -> None:
+    """Turn observability on (idempotent; never turns anything off —
+    a later Config that leaves ``tpu_metrics`` at its default must not
+    silently disable what an earlier one enabled)."""
+    if metrics:
+        _state.metrics = True
+        from .telemetry import ensure_compile_listener
+        ensure_compile_listener()
+    if trace or trace_dir:
+        _tracing.enable_tracing(trace_dir)
+    if device_time is not None:
+        _state.device_time = bool(device_time)
+
+
+def disable() -> None:
+    """Turn instrumentation off (collected metrics/events persist until
+    :func:`reset`). Primarily for tests."""
+    _state.metrics = False
+    _tracing.disable_tracing()
+    from .telemetry import pause_compile_listener
+    pause_compile_listener()
+
+
+def enabled() -> bool:
+    """Is the METRICS pillar live (the gate hot paths check)?"""
+    return _state.metrics
+
+
+def any_enabled() -> bool:
+    return _state.metrics or _tracing.tracing_enabled()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+class _Span:
+    """Reentrant-per-instance span context manager (one per call)."""
+
+    __slots__ = ("_t", "_force")
+
+    def __init__(self, name: str, args: Dict[str, Any], sync,
+                 force: bool) -> None:
+        self._t = _tracing._SpanTimer(name, args, sync)
+        self._force = force
+
+    def __enter__(self) -> "_Span":
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._t.stop(_tracing.tracing_enabled(),
+                     _observe_span if (_state.metrics or self._force)
+                     else None)
+
+
+def _observe_span(name: str, dur: float) -> None:
+    _metrics.registry().histogram(name).observe(dur)
+
+
+def span(name: str, sync: Optional[Callable[[], Any]] = None,
+         force: bool = False, **attrs):
+    """Scoped phase timer: records a duration histogram under ``name``
+    (when metrics are on) and a Chrome-trace event (when tracing is on).
+
+    ``sync``: optional callable (e.g. ``lambda:
+    jax.block_until_ready(x)``) invoked before the span closes when
+    ``device_time`` is enabled, splitting dispatch wall time from
+    device completion time in the trace args.
+
+    ``force=True`` records even when observability is globally off
+    (explicit-measurement callers: utils/timer shim, benches).
+
+    No-op (a shared null context manager) when everything is off.
+    """
+    if not (force or _state.metrics or _tracing.tracing_enabled()):
+        return _NULL_CM
+    return _Span(name, attrs,
+                 sync if (sync is not None and _state.device_time)
+                 else None, force)
+
+
+# ---------------------------------------------------------------------------
+# metric helpers (hot-path funnels; force bypasses the global gate)
+# ---------------------------------------------------------------------------
+def inc(name: str, n: float = 1.0, force: bool = False,
+        **labels) -> None:
+    if _state.metrics or force:
+        _metrics.registry().counter(name, **labels).inc(n)
+
+
+def set_gauge(name: str, value: float, force: bool = False,
+              **labels) -> None:
+    if _state.metrics or force:
+        _metrics.registry().gauge(name, **labels).set(value)
+
+
+def observe(name: str, value: float, force: bool = False,
+            **labels) -> None:
+    if _state.metrics or force:
+        _metrics.registry().histogram(name, **labels).observe(value)
+
+
+def counter(name: str, **labels) -> _metrics.Counter:
+    return _metrics.registry().counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> _metrics.Gauge:
+    return _metrics.registry().gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> _metrics.Histogram:
+    return _metrics.registry().histogram(name, **labels)
+
+
+# ---------------------------------------------------------------------------
+# exporters / state
+# ---------------------------------------------------------------------------
+def snapshot(refresh_device: bool = True) -> Dict[str, Any]:
+    """Full registry snapshot; refreshes the device/compile gauges
+    first so HBM and program-cache numbers are current."""
+    if refresh_device and any_enabled():
+        from .telemetry import refresh_device_gauges
+        refresh_device_gauges()
+    return _metrics.registry().snapshot()
+
+
+def dump_jsonl(path: str, snap: Optional[Dict[str, Any]] = None) -> str:
+    """Append one snapshot line to ``path``. Pass ``snap`` to dump an
+    already-taken snapshot (the benches print their metric line and
+    dump from the SAME dict so the two can never disagree); otherwise
+    a fresh device-gauge-refreshed snapshot is taken."""
+    return _metrics.registry().dump_jsonl(
+        path, snap if snap is not None else snapshot())
+
+
+def prometheus_text() -> str:
+    return prometheus_from_snapshot(snapshot())
+
+
+def export_state() -> Dict[str, Any]:
+    """Serializable metrics state for checkpoints (metrics pillar only;
+    trace events are a per-process artifact, not training state)."""
+    return _metrics.registry().export_state()
+
+
+def import_state(state: Optional[Dict[str, Any]]) -> int:
+    return _metrics.registry().import_state(state)
+
+
+def reset(prefix: Optional[str] = None) -> None:
+    """Clear collected metrics (all, or a name prefix) and — when
+    clearing everything — the trace buffer. Enable flags persist."""
+    _metrics.registry().reset(prefix)
+    if prefix is None:
+        _tracing.reset_events()
+
+
+# ---------------------------------------------------------------------------
+# Config wiring (called from Config._post_process; see config.py knobs)
+# ---------------------------------------------------------------------------
+def configure_from_config(cfg) -> None:
+    """Engage pillars the config asks for. Enable-only: a Config built
+    with default knobs mid-run (train() builds several) never disables
+    what an earlier explicit config enabled."""
+    want_metrics = bool(getattr(cfg, "tpu_metrics", False))
+    tdir = str(getattr(cfg, "tpu_trace_dir", "") or "").strip()
+    dump = str(getattr(cfg, "tpu_metrics_dump", "") or "").strip()
+    if want_metrics or dump:
+        enable(metrics=True)
+    if tdir:
+        enable(metrics=False, trace_dir=tdir)
+
+
+def flush_from_config(cfg) -> None:
+    """End-of-run exports the config asked for: the JSONL metrics
+    snapshot (``tpu_metrics_dump``) and the Chrome trace file
+    (``tpu_trace_dir``). Idempotent and exception-safe — a failed
+    export warns, it never fails the training run that produced it."""
+    from ..utils import log
+    dump = str(getattr(cfg, "tpu_metrics_dump", "") or "").strip()
+    if dump:
+        try:
+            dump_jsonl(dump)
+        except Exception as e:
+            log.warning(f"tpu_metrics_dump: cannot write {dump!r}: {e}")
+    if _tracing.tracing_enabled() and _tracing.trace_dir():
+        try:
+            export_chrome_trace()
+        except Exception as e:
+            log.warning(f"tpu_trace_dir: cannot export trace: {e}")

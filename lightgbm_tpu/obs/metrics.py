@@ -1,0 +1,349 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Reference: the reference ships per-iteration eval logging plus global
+timing counters around its tree learners (utils/log.h timer macros,
+UNVERIFIED — empty mount, see SURVEY.md banner). The TPU-native
+equivalent must also see DEVICE health — compile storms, HBM creep —
+so the registry here is the one sink every subsystem feeds
+(obs/telemetry.py promotes the jax-side probes into gauges) and every
+exporter reads (JSONL snapshots, a Prometheus-style text dump, the
+``Booster.metrics()`` API).
+
+Design constraints:
+
+- dependency-free and import-light: stdlib only, never imports jax
+  (obs/telemetry.py owns the jax-touching probes);
+- thread-safe: serving is threaded, so metric creation takes the
+  registry lock and every update takes the metric's own lock
+  (tests/test_obs.py hammers one counter from many threads);
+- label support: one logical name fans out per label set
+  (``counter("predict.requests", model="a")``), Prometheus-style;
+- monotonic timestamps: wall clocks step (NTP), so freshness fields
+  (``updated``) use ``time.monotonic`` and only snapshot envelopes
+  carry a wall ``ts``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry", "DEFAULT_BUCKETS", "prometheus_from_snapshot"]
+
+# latency-oriented exponential-ish bucket ladder (seconds), the usual
+# Prometheus shape: sub-ms serving calls up to minute-scale constructs
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, float("inf"))
+
+
+def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "?"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self.updated = time.monotonic()
+
+    # subclasses fill these
+    def value_dict(self) -> Dict[str, Any]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def load_value(self, payload: Dict[str, Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {"name": self.name, "type": self.kind,
+                   "updated_monotonic": self.updated}
+            if self.labels:
+                out["labels"] = dict(self.labels)
+            out.update(self.value_dict())
+            return out
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (resets only with the process /
+    an explicit registry reset)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        super().__init__(name, labels)
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+            self.updated = time.monotonic()
+
+    def value_dict(self) -> Dict[str, Any]:
+        return {"value": self.value}
+
+    def load_value(self, payload: Dict[str, Any]) -> None:
+        with self._lock:
+            self.value = float(payload.get("value", 0.0))
+            self.updated = time.monotonic()
+
+
+class Gauge(_Metric):
+    """Point-in-time value (HBM bytes, cache sizes, process count)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        super().__init__(name, labels)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+            self.updated = time.monotonic()
+
+    def add(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+            self.updated = time.monotonic()
+
+    def value_dict(self) -> Dict[str, Any]:
+        return {"value": self.value}
+
+    def load_value(self, payload: Dict[str, Any]) -> None:
+        with self._lock:
+            self.value = float(payload.get("value", 0.0))
+            self.updated = time.monotonic()
+
+
+class Histogram(_Metric):
+    """Distribution: count / sum / min / max plus cumulative bucket
+    counts over fixed upper bounds (``le`` semantics, last bound +inf)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: Dict[str, str],
+                 buckets: Optional[Tuple[float, ...]] = None):
+        super().__init__(name, labels)
+        b = tuple(buckets or DEFAULT_BUCKETS)
+        if b[-1] != float("inf"):
+            b = b + (float("inf"),)
+        self.bounds = b
+        self.bucket_counts = [0] * len(b)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            for i, bound in enumerate(self.bounds):
+                if v <= bound:
+                    self.bucket_counts[i] += 1
+                    break
+            self.updated = time.monotonic()
+
+    def value_dict(self) -> Dict[str, Any]:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "buckets": [[b if b != float("inf") else "+Inf", c]
+                            for b, c in zip(self.bounds,
+                                            self.bucket_counts)]}
+
+    def load_value(self, payload: Dict[str, Any]) -> None:
+        with self._lock:
+            self.count = int(payload.get("count", 0))
+            self.sum = float(payload.get("sum", 0.0))
+            self.min = payload.get("min")
+            self.max = payload.get("max")
+            saved = payload.get("buckets") or []
+            if len(saved) == len(self.bounds):
+                self.bucket_counts = [int(c) for _b, c in saved]
+            self.updated = time.monotonic()
+
+
+class MetricsRegistry:
+    """Get-or-create store of metrics keyed on (name, labels)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                            _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, labels: Dict[str, Any],
+                       **kwargs) -> _Metric:
+        key = (str(name), _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(str(name), dict(labels), **kwargs)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get_or_create(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get_or_create(Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Tuple[float, ...]] = None,
+                  **labels) -> Histogram:
+        return self._get_or_create(Histogram, name, labels,
+                                   buckets=buckets)
+
+    def get(self, name: str, **labels) -> Optional[_Metric]:
+        return self._metrics.get((str(name), _label_key(labels)))
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def reset(self, prefix: Optional[str] = None,
+              kind: Optional[str] = None) -> None:
+        """Drop metrics: all of them, only those whose name starts with
+        ``prefix``, and/or only those of ``kind``
+        ("counter"/"gauge"/"histogram"). The timer-shim back-compat
+        path resets ``kind="histogram"`` so clearing phase timers never
+        zeroes the compile/restart counters or device gauges."""
+        with self._lock:
+            if prefix is None and kind is None:
+                self._metrics.clear()
+                return
+            for key in [k for k, m in self._metrics.items()
+                        if (prefix is None or k[0].startswith(prefix))
+                        and (kind is None or m.kind == kind)]:
+                del self._metrics[key]
+
+    # -- exporters ------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """One self-describing JSON-able snapshot of every metric."""
+        return {
+            "schema": "lightgbm-tpu-metrics-v1",
+            "ts": time.time(),
+            "monotonic": time.monotonic(),
+            "pid": os.getpid(),
+            "metrics": [m.snapshot() for m in self.metrics()],
+        }
+
+    def dump_jsonl(self, path: str,
+                   snap: Optional[Dict[str, Any]] = None) -> str:
+        """Append one snapshot line to ``path`` (JSONL); pass ``snap``
+        to write an already-taken snapshot. The ONE writer every dump
+        path (obs.dump_jsonl, flush_from_config, the benches) funnels
+        through."""
+        if snap is None:
+            snap = self.snapshot()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(snap) + "\n")
+        return path
+
+    def prometheus_text(self) -> str:
+        return prometheus_from_snapshot(self.snapshot())
+
+    # -- state persistence (checkpoint/restore) -------------------------
+    def export_state(self) -> Dict[str, Any]:
+        """Serializable registry state for checkpoints (metric values
+        only — bucket layouts re-derive from the metric definitions)."""
+        return {"version": 1, "metrics": [m.snapshot()
+                                          for m in self.metrics()]}
+
+    def import_state(self, state: Optional[Dict[str, Any]]) -> int:
+        """Adopt a saved registry state: each saved metric is re-created
+        (or found) and its value OVERWRITTEN with the saved payload —
+        the resume contract is "continue the interrupted run's metrics",
+        not "merge two runs". Returns the number of metrics restored."""
+        if not state:
+            return 0
+        restored = 0
+        for m in state.get("metrics", []):
+            name = m.get("name")
+            kind = m.get("type")
+            labels = m.get("labels") or {}
+            if not name or kind not in ("counter", "gauge", "histogram"):
+                continue
+            try:
+                if kind == "counter":
+                    target = self.counter(name, **labels)
+                elif kind == "gauge":
+                    target = self.gauge(name, **labels)
+                else:
+                    bounds = tuple(
+                        float("inf") if b == "+Inf" else float(b)
+                        for b, _c in (m.get("buckets") or [])) or None
+                    target = self.histogram(name, buckets=bounds,
+                                            **labels)
+                target.load_value(m)
+                restored += 1
+            except TypeError:
+                # kind collision with a live metric: keep the live one
+                continue
+        return restored
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in str(name):
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def prometheus_from_snapshot(snap: Dict[str, Any]) -> str:
+    """Prometheus-style text exposition built from a snapshot dict (the
+    live registry and ``task=dump_metrics``' file reader share this)."""
+    lines: List[str] = []
+    typed: Dict[str, str] = {}
+    for m in snap.get("metrics", []):
+        name = _prom_name(m.get("name", ""))
+        kind = m.get("type", "gauge")
+        if typed.get(name) is None:
+            lines.append(f"# TYPE {name} {kind}")
+            typed[name] = kind
+        labels = m.get("labels") or {}
+        lab = ("{" + ",".join(f'{_prom_name(k)}="{v}"'
+                              for k, v in sorted(labels.items())) + "}"
+               if labels else "")
+        if kind in ("counter", "gauge"):
+            lines.append(f"{name}{lab} {m.get('value', 0.0):g}")
+            continue
+        # histogram: cumulative buckets + _sum/_count
+        cum = 0
+        for bound, c in m.get("buckets", []):
+            cum += int(c)
+            le = bound if bound == "+Inf" else f"{float(bound):g}"
+            extra = (dict(labels, le=le))
+            lab_b = "{" + ",".join(
+                f'{_prom_name(k)}="{v}"'
+                for k, v in sorted(extra.items())) + "}"
+            lines.append(f"{name}_bucket{lab_b} {cum}")
+        lines.append(f"{name}_sum{lab} {m.get('sum', 0.0):g}")
+        lines.append(f"{name}_count{lab} {m.get('count', 0)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem feeds."""
+    return _REGISTRY

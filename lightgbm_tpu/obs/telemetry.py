@@ -1,0 +1,115 @@
+"""Device/compile telemetry: jax-side probes promoted to obs metrics.
+
+Three classes of device signal become first-class metrics here instead
+of test-only assertions:
+
+- **Compile events.** ``utils/debug.py``'s :class:`CompileWatch` counts
+  XLA compile requests inside a scoped test block; production needs the
+  same signal continuously — a recompile storm in warm serving is an
+  outage precursor. One process-wide ``jax.monitoring`` listener feeds
+  the ``compile.requests`` counter (same event prefix CompileWatch
+  keys on, imported so the two can never drift).
+- **Program cache sizes.** The bounded-compile-cache guarantees
+  (predict bucketing, ingest fixed-shape chunking) become gauges:
+  ``compile.predict_programs`` / ``compile.ingest_programs``.
+- **HBM occupancy.** ``utils/hbm.py``'s limit probe plus the runtime's
+  ``memory_stats`` become ``hbm.bytes_limit`` / ``hbm.bytes_in_use`` /
+  ``hbm.peak_bytes_in_use``, so HBM creep shows up as a metric trend,
+  not a device OOM.
+
+Everything here tolerates jax being absent/uninitialized (CPU CI,
+pre-import probes): failures degrade to missing gauges, never raise.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .metrics import registry
+
+# the compile-request event prefix — imported from utils/debug.py so
+# CompileWatch (scoped, test-facing) and this listener (continuous,
+# metric-facing) count the same thing by construction
+try:
+    from ..utils.debug import _COMPILE_EVENT_PREFIX as COMPILE_EVENT_PREFIX
+except Exception:  # pragma: no cover - debug.py is a sibling module
+    COMPILE_EVENT_PREFIX = "/jax/compilation_cache/compile_requests"
+
+__all__ = ["COMPILE_EVENT_PREFIX", "ensure_compile_listener",
+           "compile_requests", "refresh_device_gauges"]
+
+_listener_registered = False
+_listener_active = False
+
+
+def _listener(event: str, **kwargs) -> None:
+    if _listener_active and event.startswith(COMPILE_EVENT_PREFIX):
+        registry().counter("compile.requests").inc()
+
+
+def ensure_compile_listener() -> bool:
+    """Register the process-wide compile-event listener (idempotent).
+    Returns True when the listener is live. The listener itself is
+    gated by an active flag so ``obs.disable()`` makes it inert without
+    touching jax's listener list (we never unregister — other watchers'
+    listeners are not ours to reorder)."""
+    global _listener_registered, _listener_active
+    if not _listener_registered:
+        try:
+            from jax import monitoring
+            monitoring.register_event_listener(_listener)
+            _listener_registered = True
+        except Exception:
+            return False
+    _listener_active = True
+    return True
+
+
+def pause_compile_listener() -> None:
+    global _listener_active
+    _listener_active = False
+
+
+def compile_requests() -> float:
+    """Compile requests counted since the listener went live."""
+    m = registry().get("compile.requests")
+    return float(getattr(m, "value", 0.0))
+
+
+def _memory_stats() -> Optional[dict]:
+    try:
+        import jax
+        return jax.devices()[0].memory_stats() or {}
+    except Exception:
+        return None
+
+
+def refresh_device_gauges() -> None:
+    """Refresh the point-in-time device gauges (called before every
+    snapshot export). Each probe is independently best-effort."""
+    reg = registry()
+    stats = _memory_stats()
+    if stats:
+        for key, gname in (("bytes_limit", "hbm.bytes_limit"),
+                           ("bytes_in_use", "hbm.bytes_in_use"),
+                           ("peak_bytes_in_use", "hbm.peak_bytes_in_use")):
+            v = stats.get(key)
+            if v is not None:
+                reg.gauge(gname).set(float(v))
+    try:
+        from ..utils.debug import predict_program_cache_size
+        reg.gauge("compile.predict_programs").set(
+            float(predict_program_cache_size()))
+    except Exception:
+        pass
+    try:
+        from ..utils.debug import ingest_program_cache_size
+        reg.gauge("compile.ingest_programs").set(
+            float(ingest_program_cache_size()))
+    except Exception:
+        pass
+    try:
+        from .tracing import dropped_events, tracing_enabled
+        if tracing_enabled():
+            reg.gauge("trace.dropped_events").set(float(dropped_events()))
+    except Exception:
+        pass

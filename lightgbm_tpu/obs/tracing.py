@@ -1,0 +1,193 @@
+"""Phase-scoped tracing: nested spans exported as Chrome-trace JSON.
+
+The deep kernel story belongs to ``jax.profiler`` (xprof/TensorBoard,
+wired via ``tpu_profile_dir``); these spans cover the HOST orchestration
+the device profiler does not attribute — round loops, chunked predict,
+ingest streaming, checkpoint writes — and export to the Chrome trace
+event format, loadable directly in Perfetto (ui.perfetto.dev) or
+chrome://tracing.
+
+Span bookkeeping is thread-local (a per-thread stack gives nesting
+depth and parent names); the event buffer is process-global, bounded,
+and lock-protected. Every event is a ``ph: "X"`` complete event with
+microsecond ``ts``/``dur`` on a monotonic base, so nesting renders as
+containment per thread row.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["tracing_enabled", "enable_tracing", "disable_tracing",
+           "record_event", "events", "dropped_events", "reset_events",
+           "export_chrome_trace", "span_stack", "trace_dir"]
+
+# bound the buffer: a runaway span site must degrade to dropped-event
+# accounting, never to unbounded host memory
+MAX_EVENTS = 200_000
+
+_lock = threading.Lock()
+_enabled = False
+_dir: Optional[str] = None
+_events: List[Dict[str, Any]] = []
+_dropped = 0
+_tls = threading.local()
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def trace_dir() -> Optional[str]:
+    return _dir
+
+
+def enable_tracing(directory: Optional[str] = None) -> None:
+    """Start collecting span events; ``directory`` (optional) is where
+    ``export_chrome_trace`` writes by default. A second different
+    directory keeps the first (one trace stream per process)."""
+    global _enabled, _dir
+    with _lock:
+        _enabled = True
+        if directory:
+            if _dir and _dir != str(directory):
+                from ..utils import log
+                log.warning(
+                    f"tpu_trace_dir={directory!r} ignored: tracing is "
+                    f"already exporting to {_dir!r} (process-global)")
+            else:
+                _dir = str(directory)
+
+
+def disable_tracing() -> None:
+    global _enabled
+    with _lock:
+        _enabled = False
+
+
+def span_stack() -> List[str]:
+    """This thread's open span names, outermost first."""
+    return list(getattr(_tls, "stack", ()))
+
+
+def _push(name: str) -> int:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(name)
+    return len(stack) - 1
+
+
+def _pop() -> None:
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack.pop()
+
+
+def record_event(name: str, start_monotonic: float, dur_s: float,
+                 args: Optional[Dict[str, Any]] = None,
+                 device_s: Optional[float] = None) -> None:
+    """Append one complete event (called by ``obs.span`` on exit)."""
+    global _dropped
+    ev: Dict[str, Any] = {
+        "name": str(name),
+        "ph": "X",
+        "ts": start_monotonic * 1e6,
+        "dur": max(dur_s, 0.0) * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0x7FFFFFFF,
+    }
+    a = dict(args or {})
+    stack = getattr(_tls, "stack", ())
+    if len(stack) > 1:
+        a["parent"] = stack[-2]
+        a["depth"] = len(stack) - 1
+    if device_s is not None:
+        a["device_s"] = device_s
+    if a:
+        ev["args"] = a
+    with _lock:
+        if len(_events) >= MAX_EVENTS:
+            _dropped += 1
+            return
+        _events.append(ev)
+
+
+def events() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_events)
+
+
+def dropped_events() -> int:
+    return _dropped
+
+
+def reset_events() -> None:
+    global _dropped
+    with _lock:
+        _events.clear()
+        _dropped = 0
+
+
+def export_chrome_trace(path: Optional[str] = None) -> Optional[str]:
+    """Write the collected events as Chrome-trace JSON and return the
+    path (None when there is nowhere to write). Default filename is
+    ``trace_<pid>.json`` under the configured trace dir; repeat exports
+    overwrite (the buffer only grows within a process)."""
+    if path is None:
+        if not _dir:
+            return None
+        path = os.path.join(_dir, f"trace_{os.getpid()}.json")
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with _lock:
+        doc = {
+            "displayTimeUnit": "ms",
+            "traceEvents": list(_events),
+            "otherData": {
+                "producer": "lightgbm-tpu obs",
+                "dropped_events": _dropped,
+            },
+        }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+class _SpanTimer:
+    """Internal helper used by ``obs.span``: measures wall (and
+    optionally device-synced) duration and feeds trace + metrics."""
+
+    __slots__ = ("name", "args", "sync", "t0", "depth")
+
+    def __init__(self, name: str, args: Dict[str, Any], sync) -> None:
+        self.name = name
+        self.args = args
+        self.sync = sync
+        self.t0 = 0.0
+        self.depth = 0
+
+    def start(self) -> None:
+        self.depth = _push(self.name)
+        self.t0 = time.monotonic()
+
+    def stop(self, record_trace: bool, observe) -> None:
+        device_s = None
+        if self.sync is not None:
+            t_dispatch = time.monotonic() - self.t0
+            try:
+                self.sync()
+            except Exception:
+                pass
+            device_s = time.monotonic() - self.t0 - t_dispatch
+        dur = time.monotonic() - self.t0
+        if record_trace:
+            record_event(self.name, self.t0, dur, self.args, device_s)
+        _pop()
+        if observe is not None:
+            observe(self.name, dur)

@@ -280,6 +280,8 @@ def device_ingest(X: np.ndarray, bin_mappers, used_features,
     """
     import jax
     import jax.numpy as jnp
+
+    from .. import obs
     used = list(used_features)
     n = int(X.shape[0])
     Fu = len(used)
@@ -308,21 +310,29 @@ def device_ingest(X: np.ndarray, bin_mappers, used_features,
     row_parts = []
     t_parts = []
     pending = None
-    for s in range(0, max(n, 1), R):
-        e = min(s + R, n)
-        chunk_dev = jax.device_put(host_prep(s, e))
-        res = _assign_chunk(chunk_dev, *dev_tables,
-                            out_dtype=out_jdtype,
-                            emit_transposed=emit_transposed,
-                            any_cat=any_cat)
-        row_parts.append(res[0])
-        if emit_transposed:
-            t_parts.append(res[1])
-        # double buffer: keep at most two chunks in flight so host prep
-        # overlaps device compute without unbounded queueing
-        if pending is not None:
-            pending.block_until_ready()
-        pending = res[0]
+    track = obs.any_enabled()
+    with obs.span("ingest/device", rows=n, features=Fu):
+        for s in range(0, max(n, 1), R):
+            e = min(s + R, n)
+            blk = host_prep(s, e)
+            chunk_dev = jax.device_put(blk)
+            if track:
+                # H2D traffic accounting: every streamed raw chunk
+                # (padded f32) crosses the host->device link once
+                obs.inc("ingest.h2d_bytes", int(blk.nbytes))
+                obs.inc("ingest.chunks")
+            res = _assign_chunk(chunk_dev, *dev_tables,
+                                out_dtype=out_jdtype,
+                                emit_transposed=emit_transposed,
+                                any_cat=any_cat)
+            row_parts.append(res[0])
+            if emit_transposed:
+                t_parts.append(res[1])
+            # double buffer: keep at most two chunks in flight so host
+            # prep overlaps device compute without unbounded queueing
+            if pending is not None:
+                pending.block_until_ready()
+            pending = res[0]
     bins = (row_parts[0] if len(row_parts) == 1
             else jnp.concatenate(row_parts, axis=0))[:n]
     bins_t = None

@@ -431,5 +431,17 @@ def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
         formulation = None
     elif formulation is None:
         formulation = default_formulation(stacked["split_feature"].shape[1])
-    return _forest_predict_impl(stacked, bins, feat_num_bin, feat_has_nan,
-                                class_index, num_class, mode, formulation)
+    from .. import obs
+    if not obs.any_enabled():
+        return _forest_predict_impl(stacked, bins, feat_num_bin,
+                                    feat_has_nan, class_index, num_class,
+                                    mode, formulation)
+    # serving dispatch span: wall time covers trace/compile + enqueue
+    # (execution is async — completion shows up where the caller blocks
+    # on the device->host copy)
+    with obs.span("predict/forest_dispatch", rows=int(bins.shape[0]),
+                  trees=int(stacked["split_feature"].shape[0]),
+                  mode=mode):
+        return _forest_predict_impl(stacked, bins, feat_num_bin,
+                                    feat_has_nan, class_index, num_class,
+                                    mode, formulation)

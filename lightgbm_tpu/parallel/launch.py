@@ -364,6 +364,8 @@ def train_distributed(params: Dict,
                 categorical_feature, timeout, resume_from)
             if (result is not None and result[0] == "err"
                     and is_bind_failure(result[1]) and bind_attempt < 2):
+                from .. import obs
+                obs.inc("restart.bind_retries", force=True)
                 log.warning(
                     "coordinator port was reclaimed before bind "
                     "(the _free_port race); relaunching the worker "
@@ -389,6 +391,13 @@ def train_distributed(params: Dict,
             raise failure
         resume_from = (ckpt_dir if ckpt_dir
                        and has_resumable_checkpoint(ckpt_dir) else None)
+        # forced: gang restarts are exactly the restart-loop signal the
+        # obs subsystem exists to surface, and the launcher runs before
+        # any Config can flip tpu_metrics on
+        from .. import obs
+        obs.inc("restart.attempts", force=True)
+        if resume_from:
+            obs.inc("restart.resumes", force=True)
         delay = backoff_seconds(attempt, restart_backoff)
         log.warning(
             f"distributed training attempt {attempt} of "

@@ -83,6 +83,8 @@ def init_multihost(coordinator_address: Optional[str] = None,
             transient = any(tok in str(e).lower()
                             for tok in _TRANSIENT_TOKENS)
             if transient and conn_attempt < connect_retries:
+                from .. import obs
+                obs.inc("multihost.connect_retries", force=True)
                 # a failed initialize leaves jax's distributed global
                 # state partially set (client assigned before connect),
                 # and a second initialize() would fail with the
@@ -105,6 +107,11 @@ def init_multihost(coordinator_address: Optional[str] = None,
                 f"must be the first JAX call), initialize() was called "
                 f"twice, or the coordinator at {coordinator_address!r} "
                 f"is unreachable.") from e
+    from .. import obs
+    obs.set_gauge("multihost.process_count", jax.process_count(),
+                  force=True)
+    obs.set_gauge("multihost.process_index", jax.process_index(),
+                  force=True)
     log.info(f"multi-host initialized: process {jax.process_index()} of "
              f"{jax.process_count()}, {jax.device_count()} global / "
              f"{jax.local_device_count()} local devices")

@@ -37,6 +37,7 @@ import re
 import tempfile
 from typing import Any, Dict, List, Optional, Union
 
+from .. import obs
 from ..utils import log
 from ..utils.log import LightGBMError
 
@@ -87,22 +88,26 @@ class CheckpointManager:
         """Atomically persist ``state`` as this rank's checkpoint for
         ``iteration``; updates the ``latest`` pointer and prunes old
         checkpoints beyond ``keep_n``."""
-        state = dict(state)
-        state.setdefault("version", CHECKPOINT_VERSION)
-        state.setdefault("iteration", int(iteration))
-        payload = pickle.dumps(state, protocol=4)
-        digest = hashlib.sha256(payload).hexdigest()
-        blob = b"\n".join([
-            MAGIC,
-            b"sha256:" + digest.encode("ascii"),
-            b"bytes:" + str(len(payload)).encode("ascii"),
-            payload,
-        ])
-        final = self.path(iteration)
-        self._atomic_write(final, blob)
-        self._atomic_write(self.latest_pointer,
-                           self.filename(iteration).encode("ascii") + b"\n")
-        self._prune(current=int(iteration))
+        with obs.span("checkpoint/save", iteration=int(iteration)):
+            state = dict(state)
+            state.setdefault("version", CHECKPOINT_VERSION)
+            state.setdefault("iteration", int(iteration))
+            payload = pickle.dumps(state, protocol=4)
+            digest = hashlib.sha256(payload).hexdigest()
+            blob = b"\n".join([
+                MAGIC,
+                b"sha256:" + digest.encode("ascii"),
+                b"bytes:" + str(len(payload)).encode("ascii"),
+                payload,
+            ])
+            final = self.path(iteration)
+            self._atomic_write(final, blob)
+            self._atomic_write(
+                self.latest_pointer,
+                self.filename(iteration).encode("ascii") + b"\n")
+            self._prune(current=int(iteration))
+        obs.inc("checkpoint.saves")
+        obs.set_gauge("checkpoint.last_save_bytes", len(payload))
         log.debug(f"checkpoint saved: {final} "
                   f"({len(payload)} bytes, sha256 {digest[:12]}…)")
         return final
@@ -184,6 +189,8 @@ class CheckpointManager:
         ``verify_only`` skips the (potentially large) unpickle and
         returns None — checkpoints carry full score arrays, so validity
         scans must not deserialize every candidate."""
+        import time
+        t0 = time.monotonic()
         try:
             with open(path, "rb") as f:
                 blob = f.read()
@@ -219,6 +226,11 @@ class CheckpointManager:
                 f"{path}: unsupported checkpoint version {ver!r} "
                 f"(this build reads version {CHECKPOINT_VERSION})")
         state["_checkpoint_path"] = path
+        # read+verify+unpickle is the restore cost a restarting gang
+        # pays per attempt — a trending restore duration is the early
+        # signal that checkpoints outgrew their write/read budget
+        obs.observe("checkpoint/restore", time.monotonic() - t0)
+        obs.inc("checkpoint.restores")
         return state
 
     def load(self, iteration: Optional[int] = None) -> Dict[str, Any]:

@@ -30,9 +30,18 @@ def hbm_bytes_limit() -> Optional[int]:
     try:
         import jax
         stats = jax.devices()[0].memory_stats() or {}
-        return stats.get("bytes_limit") or None
+        limit = stats.get("bytes_limit") or None
     except Exception:
         return None
+    if limit is not None:
+        # every gate probe refreshes the obs gauge, so the limit the
+        # HBM-budget decisions reasoned about is the one the metrics
+        # snapshot shows (obs/telemetry.py refreshes the in-use/peak
+        # side at snapshot time)
+        from .. import obs
+        if obs.enabled():
+            obs.set_gauge("hbm.bytes_limit", float(limit))
+    return limit
 
 
 def binned_device_bytes(n_rows: int, n_features: int, itemsize: int,

@@ -43,6 +43,43 @@ if [[ "$RC" != 0 ]]; then
 fi
 
 # tiny bench: exercises the real flagship path end to end (train +
-# predict + AUC) and proves bench.py emits its JSON line with rc=0
-python bench.py --rows 300000 --iters 5 --smoke
-echo "check.sh: OK (timing logged to scripts/check_timings.log)"
+# predict + AUC) and proves bench.py emits its JSON line with rc=0.
+# --metrics-json doubles as the obs-subsystem gate: the run must
+# produce a well-formed metrics snapshot (docs/observability.md)
+OBS_JSON=/tmp/_check_obs_metrics.jsonl
+rm -f "$OBS_JSON"
+python bench.py --rows 300000 --iters 5 --smoke --metrics-json "$OBS_JSON"
+
+# machine-readable obs line appended next to the plain timing line:
+# dots/seconds from this run plus compile count and peak-HBM estimate
+# read back from the snapshot. A malformed dump FAILS the gate — a
+# check that silently skips its own telemetry is how telemetry rots.
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" <<'PY' >> scripts/check_timings.log
+import json, sys, time
+path, mode, dots, secs, rev = sys.argv[1:6]
+try:
+    lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
+    snap = json.loads(lines[-1])
+    if snap.get("schema") != "lightgbm-tpu-metrics-v1":
+        raise ValueError(f"unexpected schema {snap.get('schema')!r}")
+except Exception as e:
+    sys.stderr.write(f"check.sh: MALFORMED obs metrics dump {path}: "
+                     f"{type(e).__name__}: {e}\n")
+    sys.exit(3)
+
+def gauge(name):
+    for m in snap.get("metrics", []):
+        if m.get("name") == name and not m.get("labels"):
+            return m.get("value")
+    return None
+
+print("obs " + json.dumps({
+    "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    "rev": rev, "mode": mode, "dots": int(dots), "secs": int(secs),
+    "compile_requests": gauge("compile.requests"),
+    "peak_hbm_gib": gauge("bench.peak_hbm_gib"),
+    "bench_iters_per_sec": gauge("bench.iters_per_sec"),
+    "predict_programs": gauge("compile.predict_programs"),
+}))
+PY
+echo "check.sh: OK (timing + obs line logged to scripts/check_timings.log)"

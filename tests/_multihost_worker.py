@@ -43,6 +43,27 @@ def make_data():
     return X, y
 
 
+def collectives_probe_child(port, q):
+    """Child body for the multiprocess-collectives capability probe
+    (conftest.py's ``multiprocess_collectives`` fixture): join a bare
+    2-process ``jax.distributed`` job and run one allgather. Lives in
+    this side-effect-free module so ``spawn`` can re-import it without
+    dragging pytest/conftest (whose import would initialize the jax
+    backend BEFORE ``jax.distributed.initialize``) into the child."""
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        rank = int(os.environ.pop("_LGBM_PROBE_RANK"))
+        jax.distributed.initialize(f"localhost:{port}", 2, rank)
+        import numpy as np
+        from jax.experimental import multihost_utils
+        got = np.asarray(multihost_utils.process_allgather(
+            np.asarray([rank], np.int64))).reshape(-1)
+        q.put(("ok", sorted(got.tolist())))
+    except Exception as e:
+        q.put(("err", f"{type(e).__name__}: {e}"))
+
+
 def main():
     rank = int(sys.argv[1])
     nproc = int(sys.argv[2])

@@ -32,11 +32,62 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 if not TPU_MODE:
     jax.config.update("jax_platforms", "cpu")
     assert jax.device_count() == 8, (
         f"expected 8 fake CPU devices, got {jax.devices()}")
+
+
+@pytest.fixture(scope="session")
+def multiprocess_collectives():
+    """Skip marker for platforms whose CPU backend cannot run ANY
+    cross-process collective (a jaxlib limitation, not a bug in the
+    code under test — this container's jaxlib is one such): two bare
+    ``jax.distributed`` processes attempt one ``process_allgather``,
+    once per session (session scope memoizes the probe). Tests that
+    fork a REAL multi-process gang (``num_machines>1`` CLI runs,
+    4-process fault-tolerance/multihost runs) request this fixture so
+    tier-1 reads zero expected failures instead of known-red tests.
+    Only a probe ERROR skips — an allgather that runs but returns wrong
+    data is a real failure and fails every dependent test."""
+    import multiprocessing as mp
+
+    from _multihost_worker import collectives_probe_child
+    from lightgbm_tpu.parallel.launch import _free_port
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = _free_port()
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = " ".join(
+        f for f in flags.split()
+        if "host_platform_device_count" not in f)
+    procs = []
+    try:
+        for rank in range(2):
+            os.environ["_LGBM_PROBE_RANK"] = str(rank)
+            p = ctx.Process(target=collectives_probe_child,
+                            args=(port, q))
+            p.start()
+            procs.append(p)
+        results = [q.get(timeout=60) for _ in range(2)]
+    except Exception as e:
+        results = [("err", f"{type(e).__name__}: {e}")]
+    finally:
+        os.environ["XLA_FLAGS"] = flags
+        os.environ.pop("_LGBM_PROBE_RANK", None)
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.kill()
+    bad = [r for r in results if r[0] != "ok"]
+    if bad:
+        pytest.skip("this jaxlib's CPU backend cannot run multi-process "
+                    f"collectives ({bad[0][1]}); single-process "
+                    f"variants still cover the code paths")
+    assert all(r[1] == [0, 1] for r in results), \
+        f"collectives returned wrong data: {results}"
 
 
 def pytest_collection_modifyitems(config, items):

