@@ -204,6 +204,10 @@ def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
         eng.train_chunk(iters)
         jax.block_until_ready(eng.score)
         rates.append(iters / (time.time() - t0))
+    from lightgbm_tpu import obs as _obs
+    _obs.set_gauge("bench.hist_partition",
+                   float(getattr(eng, "hist_partition", False)),
+                   force=True)
     return statistics.median(rates), auc, bin_time, predict_rps
 
 
@@ -230,6 +234,12 @@ def main():
                          "a later --goss/--quant re-enables that piece)")
     ap.add_argument("--precise", action="store_true",
                     help="tpu_double_precision_hist (f32 histograms)")
+    ap.add_argument("--partition", choices=["auto", "true", "false"],
+                    default="auto",
+                    help="leaf-ordered row partition "
+                         "(tpu_hist_partition; docs/perf.md "
+                         "'Partitioned histograms'): histograms scan "
+                         "only the elected children's row spans")
     ap.add_argument("--ingest", choices=["auto", "device", "host"],
                     default="auto",
                     help="bin-assignment path for Dataset.construct "
@@ -279,6 +289,7 @@ def main():
     if args.ingest != "auto":
         params["tpu_ingest_device"] = ("true" if args.ingest == "device"
                                        else "false")
+    params["tpu_hist_partition"] = args.partition
     if args.compile_cache:
         params["tpu_compile_cache_dir"] = args.compile_cache
     from lightgbm_tpu import obs
@@ -347,6 +358,13 @@ def main():
     extras += f"; median-of-{args.windows}"
     extras += (f"; predict_rps="
                f"{_snap_gauge(snap, 'bench.predict_rps'):.0f}")
+    v = _snap_gauge(snap, "bench.hist_partition")
+    extras += f"; partition={'on' if v else 'off'}"
+    v = _snap_gauge(snap, "hist.rows_scanned")
+    if v:
+        # the structural win the partition exists for: total rows the
+        # histogram scans touched (masked = n_pad x rounds)
+        extras += f"; hist_rows_scanned={v:.3g}"
     v = _snap_gauge(snap, "bench.plain1m_iters_per_sec")
     if v is not None:
         extras += (f"; plain1m={v:.2f}@auc"

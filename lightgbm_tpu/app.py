@@ -66,6 +66,11 @@ def _cli_file_shard(data_path: str, params: Dict[str, Any],
                   "use lightgbm_tpu.run_worker with a group-aligned "
                   "data_fn")
     n = len(loaded.X)
+    if n < nproc:
+        log.fatal(f"num_machines={nproc} exceeds the data file's row "
+                  f"count ({n}): every worker needs at least one row "
+                  f"(contiguous sharding would hand rank(s) an empty "
+                  f"shard) — lower num_machines or provide more data")
     blk = n // nproc
     lo = rank * blk
     hi = n if rank == nproc - 1 else lo + blk

@@ -26,9 +26,16 @@ def _streaming_compatible(config) -> bool:
             and not bool(config.linear_tree)
             and not bool(config.monotone_constraints)
             and not bool(config.interaction_constraints)
+            # StreamingGBDT rejects ANY CEGB knob, including a bare
+            # non-default cegb_tradeoff
+            and config.cegb_tradeoff == 1.0
             and config.cegb_penalty_split <= 0
             and not bool(config.cegb_penalty_feature_coupled)
             and not bool(config.cegb_penalty_feature_lazy)
+            # explicit quantization fatals in streaming (auto-quantize
+            # is quietly demoted there, so it stays routable)
+            and not (bool(config.use_quantized_grad)
+                     and not getattr(config, "_quantize_auto", False))
             and not bool(config.forcedsplits_filename)
             and not bool(config.categorical_feature)
             and str(config.objective) not in ("lambdarank",

@@ -308,11 +308,19 @@ class StreamingGBDT:
         tunneled chip every separate device->host pull pays ~30-100 ms
         of latency, and the unpacked dict was ~20 pulls per level.
         ``allowed`` is a TRACED argument (same [F] bool shape every
-        call) so per-tree feature_fraction masks never recompile."""
+        call) so per-tree feature_fraction masks never recompile.
+        With ``extra_trees``, per-(leaf, feature) uniforms ride a
+        fourth traced argument (drawn host-side from ``self._rng`` per
+        level — mirroring learner/serial.py's per-round draws), so the
+        one-random-threshold-per-node semantics actually bind instead
+        of silently degrading to plain GBDT (find_best_split skips the
+        extra_trees filter when extra_u is None)."""
+        use_extra = bool(self._scfg.extra_trees)
 
-        def one(h, p, allowed):
+        def one(h, p, allowed, eu):
             r = find_best_split(h, p, self.feat_num_bin,
-                                self.feat_has_nan, allowed, self._scfg)
+                                self.feat_has_nan, allowed, self._scfg,
+                                extra_u=eu)
             return jnp.concatenate([
                 jnp.stack([r["gain"], r["feature"].astype(jnp.float32),
                            r["threshold_bin"].astype(jnp.float32),
@@ -321,7 +329,8 @@ class StreamingGBDT:
                 r["right_sums"].astype(jnp.float32),
                 p.astype(jnp.float32)])
 
-        return jax.jit(jax.vmap(one, in_axes=(0, 0, None)))
+        return jax.jit(jax.vmap(
+            one, in_axes=(0, 0, None, 0 if use_extra else None)))
 
     def _leaf_out_np(self, g: float, h: float) -> float:
         """calc_leaf_output (ops/split.py) in host numpy — leaf outputs
@@ -357,6 +366,17 @@ class StreamingGBDT:
                 what="valid sets without in-memory raw features "
                      "(file-backed, or already constructed with the "
                      "raw matrix freed — pass a fresh Dataset)"))
+        if not hasattr(raw, "shape"):
+            # scipy sparse would also fail later (len() raises on
+            # sparse, and the host-model traversal reads dense rows) —
+            # reject anything non-array-like up front with the standard
+            # message instead of crashing mid-eval
+            log.fatal(self._UNSUPPORTED_MSG.format(
+                what="valid sets whose raw features are not an array"))
+        if hasattr(raw, "tocsr") and not isinstance(raw, np.ndarray):
+            log.fatal(self._UNSUPPORTED_MSG.format(
+                what="sparse raw valid features (densify with "
+                     ".toarray() first)"))
         self.valid_data.append(data)
         self.valid_names.append(name)
 
@@ -389,8 +409,10 @@ class StreamingGBDT:
             # init score into tree 0, so increments sum exactly);
             # without this, per-iteration eval would rebuild and
             # re-traverse the whole forest — O(T^2) over training
+            # shape[0], not len(): valid row count must not depend on
+            # the raw container's __len__ (absent on scipy sparse)
             done, raw = self._valid_raw_cache.get(
-                which, (0, np.zeros(len(ds.data), np.float64)))
+                which, (0, np.zeros(int(ds.data.shape[0]), np.float64)))
             n_now = len(self.models)
             if n_now > done:
                 raw = raw + self.predict(
@@ -500,9 +522,14 @@ class StreamingGBDT:
             # leaf totals straight from the histogram: any one
             # feature's bins partition the leaf's rows
             parent_sums = jnp.sum(hist[:, 0, :, :], axis=1)
+            # per-level extra_trees uniforms (one random threshold per
+            # (leaf, feature)); None when off — the jitted find's
+            # in_axes already match
+            eu = (jnp.asarray(self._rng.random((K_pad, F)), jnp.float32)
+                  if self._scfg.extra_trees else None)
             # ONE device->host pull per level (packed [K_pad, 13])
             bests = np.asarray(self._find(hist, parent_sums,
-                                          allowed_dev), np.float64)
+                                          allowed_dev, eu), np.float64)
             for i, lf in enumerate(frontier):
                 leaf_sums[lf] = bests[i, 10:13]
             table = self._empty_table()

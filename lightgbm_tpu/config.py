@@ -391,6 +391,17 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # pack into the matmul N dim, so the second child rides the MXU's
     # 128-lane padding — bounding memory to O(leaf_batch * F * B)
     "tpu_hist_mode": _P("str", "pool"),
+    # leaf-ordered device row partition (ops/partition.py): rows ride
+    # the grow-loop carry physically grouped by leaf, and each round's
+    # histogram scans only the elected children's padded row spans
+    # (pow2-bucketed budgets; siblings by pool subtraction) instead of
+    # a masked full scan — the reference CUDADataPartition's "fewer
+    # rows" lever. Trees are structurally identical to the masked path
+    # (bit-exact under use_quantized_grad). "auto" engages where the
+    # repartition move pays for itself (Pallas pool path, large
+    # un-compacted source); "true" forces it wherever the move
+    # machinery exists; "false" keeps masked full scans.
+    "tpu_hist_partition": _P("str", "auto"),
 }
 
 def parse_interaction_constraints(spec) -> List[List[int]]:
@@ -619,6 +630,8 @@ class Config:
                                              "tpu_streaming")
         self.tpu_ingest_device = coerce_tristate(self.tpu_ingest_device,
                                                  "tpu_ingest_device")
+        self.tpu_hist_partition = coerce_tristate(self.tpu_hist_partition,
+                                                  "tpu_hist_partition")
         setup_compile_cache(self.tpu_compile_cache_dir)
         # observability knobs engage process-wide (enable-only: the 2-3
         # Config objects one train() builds must not flip it back off)

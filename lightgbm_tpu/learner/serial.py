@@ -131,6 +131,18 @@ class GrowConfig:
     # histogrammed directly each round (one scan, masks packed into the
     # matmul N dim), bounding memory to O(leaf_batch * F * B)
     hist_rebuild: bool = False
+    # leaf-ordered device row partition (ops/partition.py;
+    # tpu_hist_partition): rows ride the carry physically grouped by
+    # leaf (per-leaf offset/count tables + a stable cumsum front/back
+    # move per round), and each round's histogram scans only the
+    # elected children's padded spans — a lax.switch over a static pow2
+    # budget ladder, falling back to the masked full scan whenever the
+    # spans would not shrink it. Siblings still come from pool
+    # subtraction (or ride the rebuild scan's N-packing).
+    partition: bool = False
+    # block size of the TPU compact_rows-based repartition move
+    # (<= 1024, divides the padded row count; the engine computes it)
+    part_rpb: int = 1024
     # per-NODE column sampling (ColSampler feature_fraction_bynode)
     feature_fraction_bynode: float = 1.0
     # CEGB gain discounts (cost_effective_gradient_boosting.hpp)
@@ -195,7 +207,15 @@ class GrowConfig:
 
 class GrowState(NamedTuple):
     """while_loop carry. Leaf arrays sized L+1 (slot L = trash); node
-    arrays sized L (slot L-1 = trash; real nodes use 0..L-2)."""
+    arrays sized L (slot L-1 = trash; real nodes use 0..L-2).
+
+    Carry-width note (round-6 %copy trim): per-leaf/per-node float
+    stats that update together are PACKED into one array each
+    (``best_lr_sums``, ``node_vcg``, ``leaf_vcw``, ``leaf_bounds``) —
+    the round-5 trace attributed ~9% of device busy to while-loop
+    ``%copy`` traffic whose cost is per-ARRAY overhead, so fewer carry
+    tuple elements means fewer copies per round at identical numerics.
+    """
 
     split_idx: jnp.ndarray
     num_leaves: jnp.ndarray
@@ -208,8 +228,7 @@ class GrowState(NamedTuple):
     best_feature: jnp.ndarray
     best_threshold: jnp.ndarray
     best_default_left: jnp.ndarray
-    best_left_sums: jnp.ndarray     # [L+1, 3]
-    best_right_sums: jnp.ndarray
+    best_lr_sums: jnp.ndarray       # [L+1, 2, 3] (left, right)
     best_is_cat: jnp.ndarray        # [L+1]
     best_cat_bitset: jnp.ndarray    # [L+1, W]
     split_feature: jnp.ndarray      # [L]
@@ -219,19 +238,15 @@ class GrowState(NamedTuple):
     node_cat_bitset: jnp.ndarray    # [L, W]
     left_child: jnp.ndarray
     right_child: jnp.ndarray
-    split_gain: jnp.ndarray
-    internal_value: jnp.ndarray
-    internal_count: jnp.ndarray
-    leaf_value: jnp.ndarray         # [L+1]
-    leaf_count: jnp.ndarray
-    leaf_weight: jnp.ndarray
+    node_vcg: jnp.ndarray           # [L, 3] (internal value/count/gain)
+    leaf_vcw: jnp.ndarray           # [L+1, 3] (value, count, weight)
     leaf_parent: jnp.ndarray
     leaf_is_left: jnp.ndarray
-    # monotone "basic" bounds ([L+1]; ±inf when unconstrained) and
-    # interaction-constraint path features ([L+1, F or 1-dummy]; the
-    # per-leaf allowed set is derived from this at split time)
-    leaf_lower: jnp.ndarray
-    leaf_upper: jnp.ndarray
+    # monotone "basic" bounds ([L+1, 2] = lower/upper; ±inf when
+    # unconstrained) and interaction-constraint path features
+    # ([L+1, F or 1-dummy]; the per-leaf allowed set is derived from
+    # this at split time)
+    leaf_bounds: jnp.ndarray
     leaf_used: jnp.ndarray
     # intermediate monotone mode: [L, L+1] membership of each leaf in
     # each node's left/right subtree ([1, 1] placeholder otherwise) —
@@ -252,6 +267,18 @@ class GrowState(NamedTuple):
     # each entry's state: -1 waiting on parent, >=0 realized target
     # leaf slot, -2 cancelled (skipped parent), -3 applied
     forced_target: jnp.ndarray
+    # leaf-ordered row partition (cfg.partition; [1]/[1,1] placeholders
+    # otherwise): the histogram source arrays physically grouped by
+    # leaf, the per-POSITION leaf ids, and the (offset, count) tables
+    part_bins: jnp.ndarray          # [F, n] fm (Pallas) / [n, F] rm
+    part_vals: jnp.ndarray          # [C, n] fm / [n, C] rm
+    part_leaf: jnp.ndarray          # [n]
+    part_off: jnp.ndarray           # [L+1]
+    part_cnt: jnp.ndarray           # [L+1]
+    # rows the histogram scans touched so far this tree (always
+    # maintained — the masked path counts n per round) — the
+    # hist.rows_scanned observability metric
+    rows_scanned: jnp.ndarray
 
 
 def _masked_gains(gain, leaf_depth, num_leaves, max_depth):
@@ -415,17 +442,83 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             # cheap anyway — halve the row block for safety margin.
             r_cap = min(r_cap, 2048)
         pr = math.gcd(cfg.rows_per_block, r_cap)
+        base_rpb = pr
+
+        def hist_kernel(b_src, v_src, l_src, ids, rpb):
+            """Raw local multi-leaf histogram over an arbitrary source
+            (the whole data, the GOSS buffer, or partition spans) —
+            cross-device reduction stays with the caller so the span
+            lax.switch never encloses a collective."""
+            return multi_leaf_histogram(
+                b_src, v_src, l_src, ids, num_bins=B,
+                rows_per_block=rpb, int_mode=cfg.int_hist)
 
         def hist_multi(leaf_id, small_ids):
-            return hist_reduce(multi_leaf_histogram(
-                h_bins_t, h_vals_t, leaf_id, small_ids, num_bins=B,
-                rows_per_block=pr, int_mode=cfg.int_hist))
+            return hist_reduce(hist_kernel(
+                h_bins_t, h_vals_t, leaf_id, small_ids, pr))
     else:
+        import math
+        base_rpb = cfg.rows_per_block
+
+        def hist_kernel(b_src, v_src, l_src, ids, rpb):
+            return multi_leaf_histogram_xla(
+                b_src, v_src, l_src, ids, num_bins=B,
+                rows_per_block=rpb, precise=cfg.precise_histogram)
+
         def hist_multi(leaf_id, small_ids):
-            return hist_reduce(multi_leaf_histogram_xla(
-                h_bins, h_vals, leaf_id, small_ids, num_bins=B,
-                rows_per_block=cfg.rows_per_block,
-                precise=cfg.precise_histogram))
+            return hist_reduce(hist_kernel(
+                h_bins, h_vals, leaf_id, small_ids,
+                cfg.rows_per_block))
+
+    # ---- leaf-ordered row partition (cfg.partition) -------------------
+    # ops/partition.py: rows (of the histogram SOURCE — the GOSS buffer
+    # under hist_compact, else all rows) ride the carry grouped by leaf;
+    # each round's histogram scans only the elected children's padded
+    # spans via a static pow2 budget ladder, falling back to the masked
+    # full scan when the spans would not shrink it.
+    use_part = cfg.partition
+    part_fm = cfg.use_pallas            # feature-major partition layout
+    n_h = h_bins.shape[0]               # histogram-source row count
+    if use_part:
+        from ..ops import partition as part_ops
+        M_span = 2 * Kb if cfg.hist_rebuild else Kb
+        part_budgets = part_ops.span_budgets(n_h, M_span)
+        # float32: the counter reaches n x rounds (x shards after the
+        # psum) — int32 wraps at the very scales the metric watches
+        _span_rows = jnp.asarray(
+            tuple(M_span * s for s in part_budgets) + (n_h,),
+            jnp.float32)
+
+        def span_hist(pb, pv, pl, ids, offs, cnts):
+            """[M, F_h, B, 3] local histograms of the elected children
+            + the rows this round's scan touched."""
+            branches = []
+            for S in part_budgets:
+                def mk(S):
+                    rpb_b = math.gcd(S, base_rpb)
+
+                    def br(pb, pv, pl, ids, offs, cnts):
+                        bcat, vcat, lcat = part_ops.slice_spans(
+                            pb, pv, pl, offs, cnts, S, part_fm)
+                        return hist_kernel(bcat, vcat, lcat, ids, rpb_b)
+                    return br
+                branches.append(mk(S))
+
+            def full_br(pb, pv, pl, ids, offs, cnts):
+                # masked full scan over the partition (pl is a valid
+                # per-position leaf vector) — the degenerate-budget
+                # fallback, never worse than the masked path
+                return hist_kernel(pb, pv, pl, ids, base_rpb)
+            branches.append(full_br)
+            need = jnp.max(jnp.where(ids >= 0, cnts, 0))
+            if not part_budgets:
+                return full_br(pb, pv, pl, ids, offs, cnts), \
+                    jnp.asarray(n_h, jnp.float32)
+            idx = jnp.sum((jnp.asarray(part_budgets, i32) < need)
+                          .astype(i32))
+            hist = jax.lax.switch(idx, branches, pb, pv, pl, ids,
+                                  offs, cnts)
+            return hist, _span_rows[idx]
 
     W = cfg.cat_words
     if not cfg.has_categorical:
@@ -661,6 +754,20 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     # ---- root ----------------------------------------------------------
     leaf_id0 = jnp.zeros(n_rows, dtype=i32)
     leaf_id0_c = jnp.zeros(n_rows_c, dtype=i32)
+    if use_part:
+        # initial layout: every histogram-source row belongs to the
+        # root, one contiguous span covering the whole buffer
+        part_bins0 = h_bins_t if part_fm else h_bins
+        part_vals0 = h_vals_t if part_fm else h_vals
+        part_leaf0 = jnp.zeros(n_h, dtype=i32)
+        part_off0 = jnp.zeros(L + 1, dtype=i32)
+        part_cnt0 = jnp.zeros(L + 1, dtype=i32).at[0].set(n_h)
+    else:
+        part_bins0 = jnp.zeros((1, 1), jnp.int8)
+        part_vals0 = jnp.zeros((1, 1), jnp.float32)
+        part_leaf0 = jnp.zeros(1, dtype=i32)
+        part_off0 = jnp.zeros(1, dtype=i32)
+        part_cnt0 = jnp.zeros(1, dtype=i32)
     root_small = jnp.concatenate(
         [jnp.zeros(1, i32), jnp.full(Kb - 1, -1, i32)]) if Kb > 1 \
         else jnp.zeros(1, i32)
@@ -717,10 +824,9 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                             root_best["threshold_bin"]),
         best_default_left=set0(jnp.zeros(L + 1, jnp.bool_),
                                root_best["default_left"]),
-        best_left_sums=set0(jnp.zeros((L + 1, 3), jnp.float32),
-                            root_best["left_sums"]),
-        best_right_sums=set0(jnp.zeros((L + 1, 3), jnp.float32),
-                             root_best["right_sums"]),
+        best_lr_sums=set0(jnp.zeros((L + 1, 2, 3), jnp.float32),
+                          jnp.stack([root_best["left_sums"],
+                                     root_best["right_sums"]])),
         best_is_cat=set0(jnp.zeros(L + 1, jnp.bool_),
                          root_best["is_cat"]),
         best_cat_bitset=set0(jnp.zeros((L + 1, W), jnp.uint32),
@@ -732,17 +838,15 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         node_cat_bitset=jnp.zeros((L, W), jnp.uint32),
         left_child=jnp.zeros(L, i32),
         right_child=jnp.zeros(L, i32),
-        split_gain=jnp.zeros(L, jnp.float32),
-        internal_value=jnp.zeros(L, jnp.float32),
-        internal_count=jnp.zeros(L, jnp.float32),
-        leaf_value=set0(jnp.zeros(L + 1, jnp.float32),
-                        leaf_out(root_sums)),
-        leaf_count=set0(jnp.zeros(L + 1, jnp.float32), root_sums[2]),
-        leaf_weight=set0(jnp.zeros(L + 1, jnp.float32), root_sums[1]),
+        node_vcg=jnp.zeros((L, 3), jnp.float32),
+        leaf_vcw=set0(jnp.zeros((L + 1, 3), jnp.float32),
+                      jnp.stack([leaf_out(root_sums), root_sums[2],
+                                 root_sums[1]])),
         leaf_parent=jnp.full(L + 1, -1, i32),
         leaf_is_left=jnp.zeros(L + 1, jnp.bool_),
-        leaf_lower=jnp.full(L + 1, -jnp.inf, jnp.float32),
-        leaf_upper=jnp.full(L + 1, jnp.inf, jnp.float32),
+        leaf_bounds=jnp.stack(
+            [jnp.full(L + 1, -jnp.inf, jnp.float32),
+             jnp.full(L + 1, jnp.inf, jnp.float32)], axis=1),
         leaf_used=jnp.zeros(
             (L + 1, F_meta if (cfg.has_interaction or cfg.has_cegb_lazy)
              else 1), jnp.bool_),
@@ -759,6 +863,14 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                    else jnp.zeros(1, i32)),
         forced_target=(jnp.where(f_parent < 0, 0, -1).astype(i32)
                        if forced is not None else jnp.zeros(1, i32)),
+        part_bins=part_bins0,
+        part_vals=part_vals0,
+        part_leaf=part_leaf0,
+        part_off=part_off0,
+        part_cnt=part_cnt0,
+        # the root histogram above scanned the whole source once
+        # (float32: n x rounds x shards overflows int32 at prod scale)
+        rows_scanned=jnp.asarray(n_h, jnp.float32),
     )
 
     node_trash = L - 1  # real nodes occupy 0..L-2
@@ -866,8 +978,9 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         thr_sel = s.best_threshold[tl_safe]
         dl_sel = s.best_default_left[tl_safe]
         gain_rec = top_gain
-        lsums_sel = s.best_left_sums[tl_safe]      # [Kb, 3]
-        rsums_sel = s.best_right_sums[tl_safe]
+        lr_sel = s.best_lr_sums[tl_safe]           # [Kb, 2, 3]
+        lsums_sel = lr_sel[:, 0]                   # [Kb, 3]
+        rsums_sel = lr_sel[:, 1]
         cat_sel = (s.best_is_cat[tl_safe] if cfg.has_categorical
                    else None)
         bs_sel = (s.best_cat_bitset[tl_safe] if cfg.has_categorical
@@ -914,10 +1027,15 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 bdef[feat_sel].astype(jnp.float32)])
         packed = jnp.stack(attr_cols, axis=1)
 
-        def apply_splits(lf_vec, bins_mat):
+        def apply_splits(lf_vec, bins_mat, fm=False):
             """Route one row set through this round's selected splits
-            (shared by the full partition and, under hist_compact, the
-            compacted buffer's partition)."""
+            (shared by the full partition, the compacted buffer's
+            partition under hist_compact, and the leaf-ordered row
+            partition's per-position ids under cfg.partition). With
+            ``fm`` the source is the FEATURE-MAJOR ``[F, n]`` int8
+            matrix (wraparound storage) — the one-hot column read
+            reduces over the leading axis, so no transpose is ever
+            materialized."""
             mk = (lf_vec[:, None] == tl_safe[None, :]) & valid[None, :]
             sel_rows = jnp.any(mk, axis=1)
             row_attr = jax.lax.dot_general(
@@ -948,9 +1066,16 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             col_ids = jnp.arange(F, dtype=i32)
             if mode_feature:
                 col_ids = col_ids + off
-            oh_f = pcol_r[:, None] == col_ids[None, :]
-            col = jnp.sum(jnp.where(oh_f, bins_mat.astype(i32), 0),
-                          axis=1)
+            if fm:
+                # int8 wraparound storage -> restore uint8 bin values
+                oh_f = pcol_r[None, :] == col_ids[:, None]     # [F, n]
+                col = jnp.sum(
+                    jnp.where(oh_f, bins_mat.astype(i32) & 0xFF, 0),
+                    axis=0)
+            else:
+                oh_f = pcol_r[:, None] == col_ids[None, :]
+                col = jnp.sum(jnp.where(oh_f, bins_mat.astype(i32), 0),
+                              axis=1)
             if mode_feature:
                 col = jax.lax.psum(col, cfg.feature_axis)
             if cfg.has_bundles:
@@ -980,9 +1105,51 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             return jnp.where(sel_rows & ~goes_left, new_leaf_r, lf_vec)
 
         leaf_id = apply_splits(lf, bins)
+        # under the leaf-ordered partition the compact-buffer masked ids
+        # are dead (histograms read part_leaf instead) — skip the pass
         leaf_id_c = (apply_splits(s.leaf_id_c, bins_c)
-                     if compact is not None else s.leaf_id_c)
+                     if compact is not None and not use_part
+                     else s.leaf_id_c)
         hist_lid = leaf_id_c if compact is not None else leaf_id
+
+        # ---- leaf-ordered repartition (cfg.partition) ------------------
+        # one stable front/back move per round: rows that routed to a
+        # RIGHT child pack (stably) to the back of the buffer, everything
+        # else packs to the front — per-leaf contiguity and within-leaf
+        # source order both survive, and the (offset, count) tables
+        # update from the same prefix sums (ops/partition.py).
+        if use_part:
+            part_leaf_mv = apply_splits(s.part_leaf, s.part_bins,
+                                        fm=part_fm)
+            moved = part_leaf_mv != s.part_leaf
+            dest, n_front, cum = part_ops.plan_split_move(moved)
+            p_off, p_cnt = part_ops.update_tables(
+                s.part_off, s.part_cnt, cum, n_front, tl_safe, new_ids,
+                valid)
+            if part_fm:
+                # TPU: two compact_rows passes (front keys, back keys);
+                # the int32 leaf ids ride as one extra float32 value
+                # channel (exact via the kernel's bf16x3 split)
+                pv_aug = jnp.concatenate(
+                    [s.part_vals,
+                     part_leaf_mv[None].astype(jnp.float32)])
+                p_bins, pv2 = part_ops.move_cols_tpu(
+                    s.part_bins, pv_aug, moved, n_front, cfg.part_rpb)
+                p_vals = pv2[:-1]
+                p_leaf = pv2[-1].astype(i32)
+            else:
+                p_bins, p_vals, p_leaf = part_ops.move_rows_xla(
+                    [s.part_bins, s.part_vals, part_leaf_mv], dest)
+        else:
+            p_bins, p_vals, p_leaf = (s.part_bins, s.part_vals,
+                                      s.part_leaf)
+            p_off, p_cnt = s.part_off, s.part_cnt
+
+        def span_tables(ids):
+            """Per-elected-child (offset, count) rows for slice_spans
+            (-1 lanes get count 0, so they match nothing)."""
+            safe = jnp.clip(ids, 0, L)
+            return p_off[safe], jnp.where(ids >= 0, p_cnt[safe], 0)
 
         lsums = lsums_sel                      # [Kb, 3]
         rsums = rsums_sel
@@ -995,7 +1162,15 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             both_ids = jnp.concatenate([
                 jnp.where(valid, top_leaf, -1),
                 jnp.where(valid, new_ids, -1)]).astype(i32)
-            hist2 = hist_multi(hist_lid, both_ids)   # [2Kb, F, B, 3]
+            if use_part:
+                # partitioned: scan only the 2Kb children's padded spans
+                offs_k, cnts_k = span_tables(both_ids)
+                raw2, span_rows = span_hist(p_bins, p_vals, p_leaf,
+                                            both_ids, offs_k, cnts_k)
+                hist2 = hist_reduce(raw2)            # [2Kb, F, B, 3]
+            else:
+                hist2 = hist_multi(hist_lid, both_ids)
+                span_rows = jnp.asarray(n_h, jnp.float32)
             left_hist, right_hist = hist2[:Kb], hist2[Kb:]
             leaf_hist = s.leaf_hist
         else:
@@ -1004,7 +1179,15 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             small_ids = jnp.where(
                 valid, jnp.where(left_smaller, top_leaf, new_ids),
                 -1).astype(i32)
-            hist_small = hist_multi(hist_lid, small_ids)  # [Kb, F, B, 3]
+            if use_part:
+                # partitioned: scan only the Kb smaller children's spans
+                offs_k, cnts_k = span_tables(small_ids)
+                raw_s, span_rows = span_hist(p_bins, p_vals, p_leaf,
+                                             small_ids, offs_k, cnts_k)
+                hist_small = hist_reduce(raw_s)      # [Kb, F, B, 3]
+            else:
+                hist_small = hist_multi(hist_lid, small_ids)
+                span_rows = jnp.asarray(n_h, jnp.float32)
             # TPU note: the [L+1, F, B, 3] pool gather/scatter by leaf id
             # lowers to serialized dynamic slices (~13 ms/round at
             # nl=127); both become one-hot matmuls on the MXU instead.
@@ -1058,7 +1241,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             # children shrink toward the SPLIT leaf's stored output
             # (feature_histogram.hpp passes tree->LeafOutput(leaf) as
             # parent_output); smoothing applies before constraint clips
-            pvals = s.leaf_value[tl_safe]
+            pvals = s.leaf_vcw[tl_safe, 0]
             lvals = smooth_output(lvals, lsums[:, 2], pvals,
                                   cfg.path_smooth)
             rvals = smooth_output(rvals, rsums[:, 2], pvals,
@@ -1082,7 +1265,7 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 node_m = jnp.where(node_ok,
                                    mono[s.split_feature], 0)     # [L]
                 act = leaf_ax < s.num_leaves                     # [L+1]
-                vals_c = s.leaf_value
+                vals_c = s.leaf_vcw[:, 0]
                 big = jnp.float32(jnp.inf)
                 if use_mono_adv:
                     # ADVANCED (AdvancedLeafConstraints): each node
@@ -1145,8 +1328,8 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                     pos & in_r, nlo_r[:, None],
                     jnp.where(neg & in_l, nlo_l[:, None], -big)), axis=0)
             else:
-                plo = s.leaf_lower[tl_safe]
-                phi = s.leaf_upper[tl_safe]
+                plo = s.leaf_bounds[tl_safe, 0]
+                phi = s.leaf_bounds[tl_safe, 1]
             lvals = jnp.clip(lvals, plo, phi)
             rvals = jnp.clip(rvals, plo, phi)
             if use_mono_inter:
@@ -1294,10 +1477,9 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 bests["threshold_bin"]),
             best_default_left=s.best_default_left.at[ids2].set(
                 bests["default_left"]),
-            best_left_sums=s.best_left_sums.at[ids2].set(
-                bests["left_sums"]),
-            best_right_sums=s.best_right_sums.at[ids2].set(
-                bests["right_sums"]),
+            best_lr_sums=s.best_lr_sums.at[ids2].set(
+                jnp.stack([bests["left_sums"], bests["right_sums"]],
+                          axis=1)),
             best_is_cat=s.best_is_cat.at[ids2].set(bests["is_cat"]),
             best_cat_bitset=s.best_cat_bitset.at[ids2].set(
                 bests["cat_bitset"]),
@@ -1312,24 +1494,21 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                 else s.best_cat_bitset[tl_safe]),
             left_child=lc,
             right_child=rc,
-            split_gain=s.split_gain.at[node_ids].set(gain_rec),
-            internal_value=s.internal_value.at[node_ids].set(
-                s.leaf_value[tl_safe] if cfg.path_smooth > 0.0
-                else leaf_out(psums)),
-            internal_count=s.internal_count.at[node_ids].set(psums[:, 2]),
-            leaf_value=s.leaf_value.at[ids2].set(
-                jnp.concatenate([lvals, rvals])),
-            leaf_count=s.leaf_count.at[ids2].set(child_sums[:, 2]),
-            leaf_weight=s.leaf_weight.at[ids2].set(child_sums[:, 1]),
+            node_vcg=s.node_vcg.at[node_ids].set(jnp.stack(
+                [s.leaf_vcw[tl_safe, 0] if cfg.path_smooth > 0.0
+                 else leaf_out(psums),
+                 psums[:, 2], gain_rec], axis=1)),
+            leaf_vcw=s.leaf_vcw.at[ids2].set(jnp.stack(
+                [jnp.concatenate([lvals, rvals]),
+                 child_sums[:, 2], child_sums[:, 1]], axis=1)),
             leaf_parent=s.leaf_parent.at[ids2].set(
                 jnp.concatenate([node_ids, node_ids])),
             leaf_is_left=s.leaf_is_left.at[ids2].set(
                 jnp.concatenate([jnp.ones(Kb, jnp.bool_),
                                  jnp.zeros(Kb, jnp.bool_)])),
-            leaf_lower=(s.leaf_lower.at[ids2].set(child_lower)
-                        if cfg.has_monotone else s.leaf_lower),
-            leaf_upper=(s.leaf_upper.at[ids2].set(child_upper)
-                        if cfg.has_monotone else s.leaf_upper),
+            leaf_bounds=(s.leaf_bounds.at[ids2].set(
+                jnp.stack([child_lower, child_upper], axis=1))
+                if cfg.has_monotone else s.leaf_bounds),
             leaf_used=(s.leaf_used.at[ids2].set(child_used)
                        if (cfg.has_interaction or cfg.has_cegb_lazy)
                        else s.leaf_used),
@@ -1340,6 +1519,12 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
             leaf_id_c=leaf_id_c,
             forced_target=(forced_tgt_next if forced is not None
                            else s.forced_target),
+            part_bins=p_bins,
+            part_vals=p_vals,
+            part_leaf=p_leaf,
+            part_off=p_off,
+            part_cnt=p_cnt,
+            rows_scanned=s.rows_scanned + span_rows,
         )
         next_gains = _masked_gains(new.best_gain, new.leaf_depth,
                                    new.num_leaves, cfg.max_depth)
@@ -1360,6 +1545,12 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
     final = jax.lax.while_loop(cond, body, state)
 
     nn = max(L - 1, 1)
+    # total rows the histogram scans touched this tree: the structural
+    # "fewer rows" win of the partition path (masked = n per round);
+    # summed over shards so every device reports the global figure
+    rows_scanned = final.rows_scanned
+    if cfg.axis_name:
+        rows_scanned = jax.lax.psum(rows_scanned, cfg.axis_name)
     tree = {
         "num_leaves": final.num_leaves,
         "split_feature": final.split_feature[:nn],
@@ -1367,12 +1558,13 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
         "default_left": final.default_left[:nn],
         "left_child": final.left_child[:nn],
         "right_child": final.right_child[:nn],
-        "split_gain": final.split_gain[:nn],
-        "internal_value": final.internal_value[:nn],
-        "internal_count": final.internal_count[:nn],
-        "leaf_value": final.leaf_value[:L],
-        "leaf_count": final.leaf_count[:L],
-        "leaf_weight": final.leaf_weight[:L],
+        "split_gain": final.node_vcg[:nn, 2],
+        "internal_value": final.node_vcg[:nn, 0],
+        "internal_count": final.node_vcg[:nn, 1],
+        "leaf_value": final.leaf_vcw[:L, 0],
+        "leaf_count": final.leaf_vcw[:L, 1],
+        "leaf_weight": final.leaf_vcw[:L, 2],
+        "hist_rows": rows_scanned,
     }
     if cfg.has_categorical:
         # only emitted when categorical features exist, so downstream

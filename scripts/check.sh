@@ -80,6 +80,12 @@ print("obs " + json.dumps({
     "peak_hbm_gib": gauge("bench.peak_hbm_gib"),
     "bench_iters_per_sec": gauge("bench.iters_per_sec"),
     "predict_programs": gauge("compile.predict_programs"),
+    # rows the training histogram scans touched (hist.rows_scanned is a
+    # counter, but the snapshot reader is name-based either way):
+    # masked = n_pad x rounds; a partition regression shows up here as
+    # this number jumping back to the masked product
+    "hist_rows_scanned": gauge("hist.rows_scanned"),
+    "hist_partition": gauge("bench.hist_partition"),
 }))
 PY
 echo "check.sh: OK (timing + obs line logged to scripts/check_timings.log)"

@@ -358,3 +358,21 @@ def test_two_round_streams_peak_rss(tmp_path):
     # below the raw matrix size itself (chunk + binned + sample pool)
     assert stream_peak < 0.6 * one_peak, (stream_peak, one_peak)
     assert stream_peak < raw_bytes, (stream_peak, raw_bytes)
+
+
+def test_cli_file_shard_rejects_too_few_rows(tmp_path):
+    """num_machines exceeding the file's row count must fatal with a
+    clear message instead of silently emitting 0-row shards (whose
+    empty datasets fail much later and much more cryptically)."""
+    import pytest as _pytest
+
+    from lightgbm_tpu.app import _cli_file_shard
+    from lightgbm_tpu.utils.log import LightGBMError
+    X, y = _data(n=3)
+    path = str(tmp_path / "tiny.csv")
+    _write_csv(path, X, y)
+    with _pytest.raises(LightGBMError, match="num_machines"):
+        _cli_file_shard(path, {}, rank=0, nproc=8)
+    # a row count >= nproc shards fine (last rank takes the remainder)
+    shard = _cli_file_shard(path, {}, rank=1, nproc=2)
+    assert len(shard["data"]) == 2

@@ -133,3 +133,59 @@ def test_streaming_valid_eval_and_early_stopping():
     assert len(aucs) == 10 and aucs[-1] > aucs[0] > 0.5
     assert "training" in evals           # device-score train metric
     assert bst.best_iteration >= 1
+
+
+def test_streaming_compatible_never_routes_fatal_configs():
+    """_streaming_compatible must be a SUBSET of what StreamingGBDT
+    accepts: auto-routing a config into its _no() fatals would turn a
+    train() that the resident engine handles into a crash (ADVICE r5:
+    use_quantized_grad and bare cegb_tradeoff were missing gates)."""
+    from lightgbm_tpu.boosting import _streaming_compatible
+    from lightgbm_tpu.config import Config
+    for extra in ({"use_quantized_grad": True},
+                  {"cegb_tradeoff": 2.0}):
+        cfg = Config(dict(BASE, **extra))
+        assert not _streaming_compatible(cfg), extra
+    # the resident engine still trains these fine
+    X, y = _data(n=2_000)
+    for extra in ({"use_quantized_grad": True},
+                  {"cegb_tradeoff": 2.0}):
+        lgb.train(dict(BASE, **extra), lgb.Dataset(X, label=y),
+                  num_boost_round=2)
+
+
+def test_streaming_extra_trees_binds():
+    """extra_trees must actually randomize streamed thresholds (it
+    used to silently fall back to plain GBDT: find_best_split skips
+    the filter when extra_u is None — ADVICE r5)."""
+    X, y = _data(n=8_000, seed=5)
+    def train(extra_trees, seed=1):
+        return lgb.train(dict(BASE, tpu_streaming="true",
+                              extra_trees=extra_trees, seed=seed),
+                         lgb.Dataset(X, label=y),
+                         num_boost_round=4).model_to_string()
+    plain = train(False)
+    extra = train(True)
+    # one random threshold per (node, feature) must change the trees
+    assert extra != plain
+    # and a different seed draws different thresholds
+    assert train(True, seed=2) != extra
+    # while the same seed reproduces exactly
+    assert train(True, seed=2) == train(True, seed=2)
+
+
+def test_streaming_sparse_valid_rejected():
+    """scipy-sparse raw valid features fail early with the standard
+    unsupported message instead of crashing mid-eval on len(sparse)
+    (ADVICE r5)."""
+    pytest.importorskip("scipy")
+    import scipy.sparse as sp
+    from lightgbm_tpu.utils.log import LightGBMError
+    X, y = _data(n=4_000)
+    ds = lgb.Dataset(X[:3_000], label=y[:3_000])
+    vs = lgb.Dataset(sp.csr_matrix(X[3_000:]), label=y[3_000:],
+                     reference=ds)
+    with pytest.raises(LightGBMError, match="sparse"):
+        lgb.train(dict(BASE, tpu_streaming="true"), ds,
+                  num_boost_round=2, valid_sets=[vs],
+                  valid_names=["val"])
