@@ -260,6 +260,11 @@ def main():
                     help="append one obs metrics-snapshot JSONL line "
                          "(docs/observability.md schema) to PATH; also "
                          "enables tpu_metrics collection for the run")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve live GET /metrics | /metrics.json | "
+                         "/healthz | /readyz on 127.0.0.1:PORT for the "
+                         "duration of the run (tpu_metrics_port "
+                         "semantics; scrape a long bench mid-flight)")
     args = ap.parse_args()
     if args.smoke:
         args.windows = 1
@@ -295,6 +300,12 @@ def main():
     from lightgbm_tpu import obs
     if args.metrics_json:
         obs.enable(metrics=True)
+    if args.metrics_port:
+        # live mid-run scraping: rolling SLO gauges + heartbeats on a
+        # localhost endpoint (the same plane tpu_metrics_port serves)
+        from lightgbm_tpu.obs.server import start_server
+        obs.enable(metrics=True, slo=True)
+        start_server(args.metrics_port)
 
     ips, auc, bin_time, predict_rps = run_config(X, y, X_ho, y_ho,
                                                  params, args.iters,

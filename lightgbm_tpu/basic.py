@@ -188,13 +188,15 @@ class Booster:
                       "pred_early_stop_margin", "contrib_force_f64")
                      if k in _kwargs}
         if self._from_model is not None:
-            return self._from_model.predict(
-                data, raw_score=raw_score, start_iteration=start_iteration,
+            return self._host_predict(
+                self._from_model, data, raw_score=raw_score,
+                start_iteration=start_iteration,
                 num_iteration=num_iteration, pred_leaf=pred_leaf,
                 pred_contrib=pred_contrib, **es_kwargs)
         if pred_contrib or es_kwargs.get("pred_early_stop"):
-            return self._to_host_model().predict(
-                data, raw_score=raw_score, start_iteration=start_iteration,
+            return self._host_predict(
+                self._to_host_model(), data, raw_score=raw_score,
+                start_iteration=start_iteration,
                 num_iteration=num_iteration, pred_leaf=pred_leaf,
                 pred_contrib=pred_contrib, **es_kwargs)
         # upstream convention: extra predict kwargs act as per-call
@@ -205,6 +207,19 @@ class Booster:
             data, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration or -1, pred_leaf=pred_leaf,
             **serving_kwargs)
+
+    def _host_predict(self, model, data, **kw) -> np.ndarray:
+        """HostModel predicts under the SAME serve instrumentation the
+        engine path uses (one shared ``obs.predict_instrumented``
+        sequence): a model-file-loaded booster and the pred_contrib /
+        pred_early_stop detours are serving paths too — /readyz,
+        slo.predict_p99_ms and the request/error counters must see
+        them, or a load-model-and-serve pod never turns ready."""
+        from . import obs
+        if not obs.any_enabled():
+            return model.predict(data, **kw)
+        return obs.predict_instrumented(
+            lambda: model.predict(data, **kw), data)
 
     # ------------------------------------------------------------------
     def _to_host_model(self):

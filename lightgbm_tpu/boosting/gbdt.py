@@ -2042,6 +2042,7 @@ class GBDT:
             host_trees = self._fetch_tree_arrays(stacked)
         self._append_host_trees(host_trees)
         obs.inc("train.iterations")
+        obs.heartbeat("train")
         if cegb_U_new is not None:
             # device-side acquisition fold already ran inside the step
             # (_cegb_u_fold): in-sample rows acquired their leaf-path
@@ -2244,6 +2245,7 @@ class GBDT:
                     self._append_host_trees(
                         {kk: v[i] for kk, v in host.items()})
             obs.inc("train.iterations", n)
+            obs.heartbeat("train")
             self.iter_ += n
             done += n
 
@@ -2561,17 +2563,10 @@ class GBDT:
             return self._predict_impl(X, raw_score, start_iteration,
                                       num_iteration, pred_leaf,
                                       **overrides)
-        try:
-            n_rows = int(X.shape[0])
-        except Exception:
-            n_rows = len(X) if hasattr(X, "__len__") else 0
-        with obs.span("predict/call", rows=n_rows):
-            out = self._predict_impl(X, raw_score, start_iteration,
-                                     num_iteration, pred_leaf,
-                                     **overrides)
-        obs.inc("predict.requests")
-        obs.inc("predict.rows", n_rows)
-        return out
+        return obs.predict_instrumented(
+            lambda: self._predict_impl(X, raw_score, start_iteration,
+                                       num_iteration, pred_leaf,
+                                       **overrides), X)
 
     def _predict_impl(self, X: np.ndarray, raw_score: bool = False,
                       start_iteration: int = 0, num_iteration: int = -1,

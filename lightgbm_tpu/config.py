@@ -341,6 +341,35 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # training finishes (implies tpu_metrics); the same schema
     # bench.py --metrics-json and scripts/check.sh consume
     "tpu_metrics_dump": _P("str", ""),
+    # ---- active observability plane (obs/slo.py, obs/server.py,
+    # obs/aggregate.py; docs/observability.md) -------------------------
+    # live metrics endpoint: serve GET /metrics (Prometheus text),
+    # /metrics.json, /healthz and /readyz on 127.0.0.1:<port> from a
+    # background daemon thread (implies tpu_metrics + windowed SLOs).
+    # 0 = off. Binds localhost ONLY; a port already in use warns and
+    # disables the endpoint instead of crashing the run
+    "tpu_metrics_port": _P("int", 0, [], (0, 65535)),
+    # rolling-SLI window for the slo.* gauges (seconds; ring of 30
+    # time buckets). Process-global once the tracker starts
+    "tpu_slo_window_s": _P("float", 0.0, [], (0.0, None)),
+    # SLO thresholds (0 = gauge-only, no threshold): a rolling predict
+    # p99 above tpu_slo_predict_p99_ms (milliseconds), or a windowed
+    # predict error ratio above tpu_slo_error_ratio, flips the
+    # slo.breached{slo=...} gauge to 1 and counts the transition in
+    # slo.breaches{slo=...}
+    "tpu_slo_predict_p99_ms": _P("float", 0.0, [], (0.0, None)),
+    "tpu_slo_error_ratio": _P("float", 0.0, [], (0.0, 1.0)),
+    # /healthz + /readyz staleness: a heartbeat.train / heartbeat.serve
+    # gauge older than this many seconds reads as a wedged loop -> 503
+    # (0 = the 60 s default)
+    "tpu_heartbeat_timeout": _P("float", 0.0, [], (0.0, None)),
+    # per-rank metrics aggregation for train_distributed gangs: each
+    # worker appends its end-of-run snapshot to
+    # <dir>/rank_<r>.jsonl (implies tpu_metrics) and the driver merges
+    # them into <dir>/merged.jsonl — counters sum, gauges keep latest,
+    # histograms bucket-add — plus the dist.round_time_spread
+    # straggler gauge (docs/observability.md)
+    "tpu_metrics_rank_dir": _P("str", ""),
     # ---- serving fast path (ops/predict.py + GBDT.predict) -----------
     # level-synchronous tree-parallel forest traversal: all T trees
     # advance one level per step as one batched MXU contraction (or a

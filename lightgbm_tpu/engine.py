@@ -179,6 +179,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     obs.registry().histogram("checkpoint/restore") \
                         .observe(dur)
         obs.inc("train.resumes", force=True)
+        # heartbeats resume from LIVE stamping, never from the saved
+        # state (export_state excludes them): mark the training loop
+        # alive the moment the resume is adopted so /readyz flips
+        # before the first post-resume round completes
+        obs.heartbeat("train")
         log.info(f"resumed training from checkpoint "
                  f"{resume_state.get('_checkpoint_path', '?')} at "
                  f"iteration {start_iter}")
@@ -226,6 +231,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
                           rounds=num_boost_round - start_iter):
                 booster.engine.train_chunk(num_boost_round - start_iter)
             booster.best_iteration = booster.current_iteration()
+            # clean completion: an absent heartbeat is "finished", a
+            # stale one is "wedged/crashed" — /healthz tells them apart
+            obs.retire_heartbeat("train")
             return booster
 
         for it in range(start_iter, num_boost_round):
@@ -238,6 +246,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
             with obs.span("train/round", round=it):
                 with obs.span("train/update"):
                     booster.update(fobj=fobj)
+                # liveness stamp: the STREAMING engine has no in-loop
+                # stamp of its own (the resident engine's
+                # train_one_iter/train_chunk stamp too — a second
+                # gauge set per round is noise-free overlap, and each
+                # layer uniquely covers a path: this one streaming,
+                # the engine-level ones hand-rolled update() loops)
+                obs.heartbeat("train")
                 if cfg.snapshot_freq > 0 \
                         and (it + 1) % cfg.snapshot_freq == 0:
                     # mid-training checkpoint (Application snapshot_freq
@@ -269,6 +284,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     break
         if booster.best_iteration < 0:
             booster.best_iteration = booster.current_iteration()
+        obs.retire_heartbeat("train")
         return booster
 
 

@@ -1,7 +1,8 @@
 """Observability subsystem: metrics registry + phase-scoped tracing +
-device/compile telemetry (docs/observability.md).
+device/compile telemetry + active SLO/serving plane
+(docs/observability.md).
 
-Three pillars, one import:
+Pillars, one import:
 
 - **Metrics** (obs/metrics.py): process-wide counters / gauges /
   histograms with labels, exported as JSONL snapshots
@@ -12,13 +13,19 @@ Three pillars, one import:
   device-synced time) into a Chrome-trace JSON viewable in Perfetto.
 - **Device telemetry** (obs/telemetry.py): compile-request counting,
   program-cache-size and HBM gauges refreshed into the registry.
+- **Active plane** (obs/slo.py + obs/server.py + obs/aggregate.py):
+  windowed SLIs (rolling p50/p99 under the same span names) with
+  threshold evaluation, a live localhost ``/metrics`` + ``/healthz`` /
+  ``/readyz`` endpoint driven by :func:`heartbeat` stamps, and
+  per-rank snapshot aggregation for ``train_distributed`` gangs.
 
 OFF BY DEFAULT and engineered for ~zero cost when off: every
 instrumented hot path funnels through :func:`span` / :func:`inc` /
 :func:`observe`, whose disabled path is one bool check and a shared
 no-op context manager — no locks, no clocks, no allocation. Enabled
 via ``Config`` knobs (``tpu_metrics=true``, ``tpu_trace_dir=DIR``,
-``tpu_metrics_dump=PATH``) or programmatically with :func:`enable`.
+``tpu_metrics_dump=PATH``, ``tpu_metrics_port=N``, ``tpu_slo_*``) or
+programmatically with :func:`enable`.
 
 Cold paths that must record regardless (restart/retry accounting, the
 benches, the utils/timer back-compat shim) pass ``force=True``.
@@ -26,9 +33,11 @@ benches, the utils/timer back-compat shim) pass ``force=True``.
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Any, Callable, Dict, Optional
 
 from . import metrics as _metrics
+from . import slo as _slo
 from . import tracing as _tracing
 from .metrics import prometheus_from_snapshot, registry
 from .tracing import (export_chrome_trace, span_stack, trace_dir,
@@ -36,8 +45,9 @@ from .tracing import (export_chrome_trace, span_stack, trace_dir,
 
 __all__ = [
     "enable", "disable", "enabled", "any_enabled", "tracing_enabled",
-    "span", "inc", "set_gauge", "observe", "counter", "gauge",
-    "histogram", "registry", "snapshot", "dump_jsonl",
+    "slo_enabled", "span", "inc", "set_gauge", "observe", "counter",
+    "gauge", "histogram", "heartbeat", "retire_heartbeat",
+    "predict_instrumented", "registry", "snapshot", "dump_jsonl",
     "prometheus_text", "prometheus_from_snapshot",
     "export_chrome_trace", "export_state", "import_state", "reset",
     "configure_from_config", "flush_from_config", "span_stack",
@@ -46,14 +56,24 @@ __all__ = [
 
 
 class _State:
-    __slots__ = ("metrics", "device_time")
+    __slots__ = ("metrics", "device_time", "slo")
 
     def __init__(self) -> None:
         self.metrics = False
         self.device_time = False
+        self.slo = False
 
 
 _state = _State()
+
+# metric-name prefixes that never ride checkpoints: monotonic-clock
+# heartbeat stamps and windowed SLO gauges (slo.* plus the windowed
+# cache-hit ratio) describe THIS process's recent behavior — importing
+# them into a resumed process would be stale at best and wrong-clock
+# at worst (heartbeats must resume from live stamping, not from saved
+# state; a resumed process whose tracker is off would otherwise expose
+# the dead process's frozen ratios forever)
+_EPHEMERAL_PREFIXES = ("heartbeat.", "slo.", "predict.cache_hit_ratio")
 
 # shared no-op context manager for disabled spans: nullcontext is
 # reentrant and reusable, so ONE instance serves every disabled site
@@ -62,10 +82,18 @@ _NULL_CM = contextlib.nullcontext()
 
 def enable(metrics: bool = True, trace_dir: Optional[str] = None,
            trace: Optional[bool] = None,
-           device_time: Optional[bool] = None) -> None:
+           device_time: Optional[bool] = None,
+           slo: Optional[bool] = None,
+           slo_window_s: Optional[float] = None,
+           slo_thresholds: Optional[Dict[str, float]] = None) -> None:
     """Turn observability on (idempotent; never turns anything off —
     a later Config that leaves ``tpu_metrics`` at its default must not
-    silently disable what an earlier one enabled)."""
+    silently disable what an earlier one enabled).
+
+    ``slo=True`` (or any ``slo_window_s`` / ``slo_thresholds``) starts
+    the windowed-SLI tracker (obs/slo.py); SLIs derive from the metric
+    feeds, so enabling SLOs implies the metrics pillar.
+    """
     if metrics:
         _state.metrics = True
         from .telemetry import ensure_compile_listener
@@ -74,12 +102,17 @@ def enable(metrics: bool = True, trace_dir: Optional[str] = None,
         _tracing.enable_tracing(trace_dir)
     if device_time is not None:
         _state.device_time = bool(device_time)
+    if slo or slo_window_s or slo_thresholds:
+        _slo.enable(window_s=slo_window_s, thresholds=slo_thresholds)
+        _state.slo = True
+        enable(metrics=True)
 
 
 def disable() -> None:
     """Turn instrumentation off (collected metrics/events persist until
     :func:`reset`). Primarily for tests."""
     _state.metrics = False
+    _state.slo = False
     _tracing.disable_tracing()
     from .telemetry import pause_compile_listener
     pause_compile_listener()
@@ -88,6 +121,11 @@ def disable() -> None:
 def enabled() -> bool:
     """Is the METRICS pillar live (the gate hot paths check)?"""
     return _state.metrics
+
+
+def slo_enabled() -> bool:
+    """Is the windowed-SLI tracker live?"""
+    return _state.slo and _slo.enabled()
 
 
 def any_enabled() -> bool:
@@ -119,6 +157,8 @@ class _Span:
 
 def _observe_span(name: str, dur: float) -> None:
     _metrics.registry().histogram(name).observe(dur)
+    if _state.slo:
+        _slo.feed_hist(name, dur)
 
 
 def span(name: str, sync: Optional[Callable[[], Any]] = None,
@@ -150,6 +190,8 @@ def inc(name: str, n: float = 1.0, force: bool = False,
         **labels) -> None:
     if _state.metrics or force:
         _metrics.registry().counter(name, **labels).inc(n)
+        if _state.slo and not labels:
+            _slo.feed_count(name, n)
 
 
 def set_gauge(name: str, value: float, force: bool = False,
@@ -162,6 +204,59 @@ def observe(name: str, value: float, force: bool = False,
             **labels) -> None:
     if _state.metrics or force:
         _metrics.registry().histogram(name, **labels).observe(value)
+        if _state.slo and not labels:
+            _slo.feed_hist(name, value)
+
+
+def heartbeat(kind: str) -> None:
+    """Stamp the ``heartbeat.<kind>`` gauge with the current monotonic
+    time. The round loop stamps ``train``, the predict path ``serve``;
+    /healthz and /readyz (obs/server.py) compare these stamps against
+    the staleness timeout. One gauge set when metrics are on, a single
+    bool check when off — heartbeat call sites ride the hot loops."""
+    if _state.metrics:
+        _metrics.registry().gauge(f"heartbeat.{kind}").set(
+            time.monotonic())
+
+
+def predict_instrumented(call: Callable[[], Any], data) -> Any:
+    """The ONE serve-instrumentation sequence every predict entry point
+    shares (engine path in boosting/gbdt.py, host-model path in
+    basic.py — two copies WOULD drift and split the SLO feeds):
+    ``predict.requests`` counts the ATTEMPT, the ``predict/call`` span
+    times it (feeding the rolling SLO window), ``predict.errors``
+    counts a raise, the serve heartbeat stamps on attempt (liveness is
+    "the loop runs", not "requests succeed"), and ``predict.rows``
+    lands on success. Callers gate on :func:`any_enabled` first — the
+    off path must stay one bool check."""
+    try:
+        n_rows = int(data.shape[0])
+    except Exception:
+        n_rows = len(data) if hasattr(data, "__len__") else 0
+    inc("predict.requests")
+    try:
+        with span("predict/call", rows=n_rows):
+            out = call()
+    except BaseException:
+        inc("predict.errors")
+        raise
+    finally:
+        heartbeat("serve")
+    inc("predict.rows", n_rows)
+    return out
+
+
+def retire_heartbeat(kind: str) -> None:
+    """Remove a heartbeat stamp at the CLEAN end of the loop it
+    tracked. A retired heartbeat is *absent* — /healthz stays green
+    for a process that finished its work and went idle — while a
+    crashed or wedged loop leaves its last stamp behind to go stale
+    (the 503 signal). Serving heartbeats are never retired: a serving
+    process with no traffic for the staleness timeout IS the signal a
+    load balancer probes for."""
+    reg = _metrics.registry()
+    if reg.get(f"heartbeat.{kind}") is not None:
+        reg.reset(prefix=f"heartbeat.{kind}", kind="gauge")
 
 
 def counter(name: str, **labels) -> _metrics.Counter:
@@ -181,10 +276,14 @@ def histogram(name: str, **labels) -> _metrics.Histogram:
 # ---------------------------------------------------------------------------
 def snapshot(refresh_device: bool = True) -> Dict[str, Any]:
     """Full registry snapshot; refreshes the device/compile gauges
-    first so HBM and program-cache numbers are current."""
+    first so HBM and program-cache numbers are current, and re-derives
+    the SLO gauges from the sliding windows (one snapshot/scrape ==
+    one SLO evaluation period)."""
     if refresh_device and any_enabled():
         from .telemetry import refresh_device_gauges
         refresh_device_gauges()
+    if _state.slo:
+        _slo.evaluate()
     return _metrics.registry().snapshot()
 
 
@@ -203,8 +302,15 @@ def prometheus_text() -> str:
 
 def export_state() -> Dict[str, Any]:
     """Serializable metrics state for checkpoints (metrics pillar only;
-    trace events are a per-process artifact, not training state)."""
-    return _metrics.registry().export_state()
+    trace events are a per-process artifact, not training state, and
+    heartbeat stamps / windowed SLO gauges are process-local monotonic
+    state that must NOT resume from a checkpoint — the live round loop
+    re-stamps them)."""
+    state = _metrics.registry().export_state()
+    state["metrics"] = [
+        m for m in state["metrics"]
+        if not str(m.get("name", "")).startswith(_EPHEMERAL_PREFIXES)]
+    return state
 
 
 def import_state(state: Optional[Dict[str, Any]]) -> int:
@@ -213,10 +319,14 @@ def import_state(state: Optional[Dict[str, Any]]) -> int:
 
 def reset(prefix: Optional[str] = None) -> None:
     """Clear collected metrics (all, or a name prefix) and — when
-    clearing everything — the trace buffer. Enable flags persist."""
+    clearing everything — the trace buffer and the windowed-SLI
+    tracker. Enable flags persist (except SLO, whose state IS the
+    tracker)."""
     _metrics.registry().reset(prefix)
     if prefix is None:
         _tracing.reset_events()
+        _slo.reset()
+        _state.slo = False
 
 
 # ---------------------------------------------------------------------------
@@ -229,10 +339,32 @@ def configure_from_config(cfg) -> None:
     want_metrics = bool(getattr(cfg, "tpu_metrics", False))
     tdir = str(getattr(cfg, "tpu_trace_dir", "") or "").strip()
     dump = str(getattr(cfg, "tpu_metrics_dump", "") or "").strip()
-    if want_metrics or dump:
+    rank_dir = str(getattr(cfg, "tpu_metrics_rank_dir", "") or "").strip()
+    port = int(getattr(cfg, "tpu_metrics_port", 0) or 0)
+    thresholds = {
+        "predict_p99_ms": float(
+            getattr(cfg, "tpu_slo_predict_p99_ms", 0.0) or 0.0),
+        "error_ratio": float(
+            getattr(cfg, "tpu_slo_error_ratio", 0.0) or 0.0),
+    }
+    thresholds = {k: v for k, v in thresholds.items() if v > 0}
+    if want_metrics or dump or rank_dir:
         enable(metrics=True)
     if tdir:
         enable(metrics=False, trace_dir=tdir)
+    # any SLO knob — a threshold, an explicit window, or the live
+    # endpoint (whose whole point is rolling SLO gauges) — starts the
+    # windowed-SLI tracker; tpu_slo_window_s alone must not be inert
+    win = float(getattr(cfg, "tpu_slo_window_s", 0.0) or 0.0)
+    if thresholds or port > 0 or win > 0:
+        enable(slo=True, slo_window_s=win or None,
+               slo_thresholds=thresholds or None)
+    if port > 0:
+        from .server import start_server
+        hb = float(getattr(cfg, "tpu_heartbeat_timeout", 0.0) or 0.0)
+        # None = knob unset: keep the live server's timeout (or the
+        # default on first start) — enable-only like every other knob
+        start_server(port, heartbeat_timeout_s=(hb if hb > 0 else None))
 
 
 def flush_from_config(cfg) -> None:

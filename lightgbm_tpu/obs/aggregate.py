@@ -1,0 +1,224 @@
+"""Per-rank metrics aggregation for ``train_distributed`` gangs.
+
+Each worker process owns its own registry; a gang-wide view needs a
+merge. The reference's analog is the socket allreduce of evaluation
+stats; here the snapshots are plain dicts, so the merge is host-side
+arithmetic over rank-labeled JSONL files:
+
+- every worker appends its end-of-run snapshot to
+  ``<tpu_metrics_rank_dir>/rank_<r>.jsonl`` (envelope carries the
+  rank);
+- after the gang joins, the rank-0 side (the ``train_distributed``
+  driver) merges the newest line of every rank file into one gang-wide
+  snapshot (``merged.jsonl``) and derives the straggler gauge
+  ``dist.round_time_spread`` = max/min of per-rank mean round time —
+  a gang whose spread trends up has a straggling worker long before it
+  has a timeout.
+
+Merge semantics (MUST be associative — workers can die and relaunch,
+so partial merges of partial gangs re-merge; tests pin
+``(A ⊕ B) ⊕ C == A ⊕ (B ⊕ C)``):
+
+- **counters** sum;
+- **gauges** keep the latest by ``updated_monotonic`` (ties break on
+  the larger value — a deterministic total order keeps the fold
+  associative when two ranks stamp in the same monotonic instant);
+- **histograms** bucket-add (counts/sums add, min-of-mins,
+  max-of-maxes). Mismatched bucket layouts — impossible between ranks
+  running the same code, possible across versions — degrade to the
+  scalar fields with ``buckets: null``, and null propagates through
+  further merges (still associative).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["merge_metrics", "merge_snapshots", "dump_rank_snapshot",
+           "read_rank_snapshots", "merge_rank_dir", "round_time_spread"]
+
+_RANK_FILE = "rank_{rank}.jsonl"
+_MERGED_FILE = "merged.jsonl"
+
+
+def _key(m: Dict[str, Any]) -> Tuple[str, str, Tuple[Tuple[str, str], ...]]:
+    labels = m.get("labels") or {}
+    return (str(m.get("name")), str(m.get("type")),
+            tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+
+
+def merge_metrics(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge two snapshot entries of the same (name, type, labels)."""
+    kind = a.get("type")
+    out = dict(a)
+    out["updated_monotonic"] = max(
+        float(a.get("updated_monotonic", 0.0)),
+        float(b.get("updated_monotonic", 0.0)))
+    if kind == "counter":
+        out["value"] = float(a.get("value", 0.0)) \
+            + float(b.get("value", 0.0))
+        return out
+    if kind == "gauge":
+        ka = (float(a.get("updated_monotonic", 0.0)),
+              float(a.get("value", 0.0)))
+        kb = (float(b.get("updated_monotonic", 0.0)),
+              float(b.get("value", 0.0)))
+        out["value"] = (a if ka >= kb else b).get("value", 0.0)
+        return out
+    if kind == "histogram":
+        out["count"] = int(a.get("count", 0)) + int(b.get("count", 0))
+        out["sum"] = float(a.get("sum", 0.0)) + float(b.get("sum", 0.0))
+        mins = [v for v in (a.get("min"), b.get("min")) if v is not None]
+        maxs = [v for v in (a.get("max"), b.get("max")) if v is not None]
+        out["min"] = min(mins) if mins else None
+        out["max"] = max(maxs) if maxs else None
+        ba, bb = a.get("buckets"), b.get("buckets")
+        if (ba is None or bb is None
+                or [x[0] for x in ba] != [x[0] for x in bb]):
+            # layout mismatch (or a prior mismatch): scalar-only; the
+            # null marker propagates so any fold order converges
+            out["buckets"] = None
+        else:
+            out["buckets"] = [[bound, int(ca) + int(cb)]
+                              for (bound, ca), (_b2, cb) in zip(ba, bb)]
+        return out
+    # unknown kinds pass the newer entry through unchanged
+    return dict(b) if (float(b.get("updated_monotonic", 0.0))
+                       > float(a.get("updated_monotonic", 0.0))) else out
+
+
+def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold rank snapshots into one gang-wide snapshot. Metric order is
+    first-seen (rank order), so repeated merges are stable.
+
+    Leaf (per-process) snapshots carry ``updated_monotonic`` stamps on
+    each process's OWN monotonic clock — per-boot epochs that are NOT
+    comparable across hosts (a 30-days-up host would win every
+    latest-gauge tie against a freshly rebooted one). Each leaf
+    snapshot's envelope records wall ``ts`` and ``monotonic`` taken at
+    the same instant, so the stamps are rebased to wall clock
+    (``ts - (monotonic - updated)``) before folding; merged snapshots
+    (no ``monotonic`` envelope) are already rebased, keeping re-merges
+    associative."""
+    merged: Dict[Tuple, Dict[str, Any]] = {}
+    ranks: List[int] = []
+    ts = 0.0
+    for snap in snaps:
+        if not snap:
+            continue
+        ts = max(ts, float(snap.get("ts", 0.0)))
+        r = snap.get("rank")
+        if r is not None:
+            ranks.append(int(r))
+        # already-merged inputs keep their provenance (partial gang
+        # merges re-merge associatively, envelope included)
+        ranks.extend(int(x)
+                     for x in snap.get("merged_from_ranks", []))
+        mono = snap.get("monotonic")
+        wall = snap.get("ts")
+        for m in snap.get("metrics", []):
+            if mono is not None and wall is not None:
+                m = dict(m)
+                m["updated_monotonic"] = float(wall) - (
+                    float(mono) - float(m.get("updated_monotonic",
+                                              mono)))
+            k = _key(m)
+            merged[k] = (merge_metrics(merged[k], m) if k in merged
+                         else dict(m))
+    return {
+        "schema": "lightgbm-tpu-metrics-v1",
+        "ts": ts,
+        "merged_from_ranks": sorted(set(ranks)),
+        "metrics": list(merged.values()),
+    }
+
+
+def round_time_spread(snaps: List[Dict[str, Any]]) -> Optional[float]:
+    """Straggler gauge: max/min of per-rank MEAN ``train/round`` time.
+    None when fewer than one rank carries round timings; 1.0 = a
+    perfectly even gang."""
+    means = []
+    for snap in snaps or []:
+        for m in snap.get("metrics", []):
+            if (m.get("name") == "train/round"
+                    and m.get("type") == "histogram"
+                    and not m.get("labels") and int(m.get("count", 0))):
+                means.append(float(m.get("sum", 0.0))
+                             / int(m.get("count")))
+    if not means or min(means) <= 0:
+        return None
+    return max(means) / min(means)
+
+
+# ---------------------------------------------------------------------------
+# rank-file plumbing (workers dump, the driver merges)
+# ---------------------------------------------------------------------------
+def dump_rank_snapshot(directory: str, rank: int,
+                       snap: Optional[Dict[str, Any]] = None) -> str:
+    """Append this process's snapshot (rank-tagged envelope) to
+    ``<directory>/rank_<rank>.jsonl``."""
+    from . import snapshot as take_snapshot
+    from .metrics import registry
+    if snap is None:
+        snap = take_snapshot()
+    snap = dict(snap)
+    snap["rank"] = int(rank)
+    path = os.path.join(str(directory),
+                        _RANK_FILE.format(rank=int(rank)))
+    return registry().dump_jsonl(path, snap)
+
+
+def read_rank_snapshots(directory: str) -> List[Dict[str, Any]]:
+    """Newest snapshot line of every ``rank_*.jsonl`` in ``directory``
+    (rank order). Unreadable/corrupt files are skipped — a rank killed
+    mid-write must not poison the gang view."""
+    out: List[Dict[str, Any]] = []
+    pattern = os.path.join(str(directory), "rank_*.jsonl")
+
+    def _rank_of(path: str) -> int:
+        m = re.search(r"rank_(\d+)\.jsonl$", path)
+        return int(m.group(1)) if m else 1 << 30
+    for path in sorted(glob.glob(pattern), key=_rank_of):
+        try:
+            with open(path) as f:
+                lines = [ln for ln in f.read().splitlines()
+                         if ln.strip()]
+            if lines:
+                out.append(json.loads(lines[-1]))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def merge_rank_dir(directory: str,
+                   write: bool = True) -> Optional[Dict[str, Any]]:
+    """Merge the newest per-rank snapshots under ``directory`` into one
+    gang-wide snapshot; append it to ``merged.jsonl`` when ``write``.
+    The merge itself runs under a span (it IS this layer's histogram
+    allreduce) and the straggler gauge rides the merged snapshot AND
+    the live registry so a scrape of the driver sees it."""
+    import time
+
+    from . import registry, span
+    snaps = read_rank_snapshots(directory)
+    if not snaps:
+        return None
+    with span("obs/rank_merge", force=True, ranks=len(snaps)):
+        merged = merge_snapshots(snaps)
+        spread = round_time_spread(snaps)
+        if spread is not None:
+            reg = registry()
+            reg.gauge("dist.round_time_spread").set(spread)
+            entry = reg.get("dist.round_time_spread").snapshot()
+            # merged snapshots carry WALL-rebased stamps (see
+            # merge_snapshots); the driver-local monotonic stamp would
+            # lose latest-wins re-merges to any longer-booted driver
+            entry["updated_monotonic"] = time.time()
+            merged["metrics"].append(entry)
+    if write:
+        registry().dump_jsonl(
+            os.path.join(str(directory), _MERGED_FILE), merged)
+    return merged

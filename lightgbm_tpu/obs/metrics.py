@@ -28,6 +28,7 @@ import json
 import os
 import threading
 import time
+from bisect import bisect_left
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -148,10 +149,9 @@ class Histogram(_Metric):
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
-            for i, bound in enumerate(self.bounds):
-                if v <= bound:
-                    self.bucket_counts[i] += 1
-                    break
+            # first bound with v <= bound ("le" semantics); binary
+            # search — this sits on the hot predict path per request
+            self.bucket_counts[bisect_left(self.bounds, v)] += 1
             self.updated = time.monotonic()
 
     def value_dict(self) -> Dict[str, Any]:
@@ -308,6 +308,23 @@ def _prom_name(name: str) -> str:
     return s
 
 
+def _prom_label_value(v: Any) -> str:
+    """Escape one label VALUE per the Prometheus text-format spec:
+    backslash, double-quote and newline must be escaped or the
+    exposition is unparseable (a model name containing ``"`` would
+    otherwise terminate the label early)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(
+        f'{_prom_name(k)}="{_prom_label_value(v)}"'
+        for k, v in sorted(labels.items())) + "}"
+
+
 def prometheus_from_snapshot(snap: Dict[str, Any]) -> str:
     """Prometheus-style text exposition built from a snapshot dict (the
     live registry and ``task=dump_metrics``' file reader share this)."""
@@ -320,22 +337,20 @@ def prometheus_from_snapshot(snap: Dict[str, Any]) -> str:
             lines.append(f"# TYPE {name} {kind}")
             typed[name] = kind
         labels = m.get("labels") or {}
-        lab = ("{" + ",".join(f'{_prom_name(k)}="{v}"'
-                              for k, v in sorted(labels.items())) + "}"
-               if labels else "")
+        lab = _prom_labels(labels)
         if kind in ("counter", "gauge"):
             lines.append(f"{name}{lab} {m.get('value', 0.0):g}")
             continue
-        # histogram: cumulative buckets + _sum/_count
+        # histogram: cumulative buckets + _sum/_count. `buckets` can be
+        # present-but-null (a cross-version gang merge degrades
+        # mismatched layouts to the scalar fields — obs/aggregate.py);
+        # render what remains instead of crashing the exposition
         cum = 0
-        for bound, c in m.get("buckets", []):
+        for bound, c in (m.get("buckets") or []):
             cum += int(c)
             le = bound if bound == "+Inf" else f"{float(bound):g}"
-            extra = (dict(labels, le=le))
-            lab_b = "{" + ",".join(
-                f'{_prom_name(k)}="{v}"'
-                for k, v in sorted(extra.items())) + "}"
-            lines.append(f"{name}_bucket{lab_b} {cum}")
+            lines.append(f"{name}_bucket{_prom_labels(dict(labels, le=le))}"
+                         f" {cum}")
         lines.append(f"{name}_sum{lab} {m.get('sum', 0.0):g}")
         lines.append(f"{name}_count{lab} {m.get('count', 0)}")
     return "\n".join(lines) + ("\n" if lines else "")

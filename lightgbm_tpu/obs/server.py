@@ -1,0 +1,225 @@
+"""Live metrics exposition: a stdlib-only HTTP endpoint for scraping.
+
+The JSONL dumps and ``Booster.metrics()`` are after-the-fact views; a
+production deployment scrapes *mid-run*. One background daemon thread
+serves four routes (Prometheus-shaped, the layout SNIPPETS.md's serving
+idioms assume):
+
+- ``GET /metrics`` — Prometheus text exposition of the live registry
+  (device gauges refreshed, SLO gauges re-evaluated per scrape — one
+  scrape == one SLO evaluation period);
+- ``GET /metrics.json`` — the same snapshot as JSON (schema
+  ``lightgbm-tpu-metrics-v1``);
+- ``GET /healthz`` — liveness: 200 while the process responds and no
+  previously-live heartbeat has gone silent; 503 when every stamped
+  heartbeat is older than the staleness timeout (a wedged round loop /
+  serving path looks exactly like this);
+- ``GET /readyz`` — readiness: 200 only when at least one heartbeat
+  (``heartbeat.train`` from the round loop, ``heartbeat.serve`` from
+  the predict path) is fresh; 503 before the first stamp, so a load
+  balancer only routes traffic at a process that has proven it can do
+  work.
+
+Safety posture: binds ``127.0.0.1`` ONLY (scrape through a sidecar /
+SSH tunnel — metrics often leak model and data shape details); a port
+already in use logs a warning and disables the server instead of
+crashing the training run that asked for it; the thread is a daemon
+and its shutdown is ExitStack-registered + atexit-hooked, matching the
+crashed-run export guarantees (a dying process never hangs on the
+scrape thread).
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from .metrics import registry
+
+__all__ = ["MetricsServer", "start_server", "stop_server", "server"]
+
+DEFAULT_HEARTBEAT_TIMEOUT_S = 60.0
+_PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _heartbeat_ages(now: Optional[float] = None) -> Dict[str, float]:
+    """Age in seconds of every stamped ``heartbeat.*`` gauge."""
+    now = time.monotonic() if now is None else now
+    ages: Dict[str, float] = {}
+    for m in registry().metrics():
+        if (m.kind == "gauge" and not m.labels
+                and m.name.startswith("heartbeat.")):
+            ages[m.name[len("heartbeat."):]] = now - float(m.value)
+    return ages
+
+
+def health_payload(ready: bool, timeout_s: float,
+                   now: Optional[float] = None) -> Tuple[int, Dict[str, Any]]:
+    """(status_code, body) for /healthz (``ready=False``) or /readyz.
+
+    Liveness tolerates "no heartbeat yet" (the server answering IS the
+    liveness proof at startup); readiness does not — a gang member that
+    joined but never completed a round must not take traffic.
+    """
+    now = time.monotonic() if now is None else now
+    ages = _heartbeat_ages(now)
+    fresh = {k: a <= timeout_s for k, a in ages.items()}
+    any_fresh = any(fresh.values())
+    if ready:
+        ok = any_fresh
+        status = "ok" if ok else ("stale" if ages else "no_heartbeat")
+    else:
+        ok = any_fresh or not ages
+        status = "ok" if ok else "stale"
+    body = {
+        "status": status,
+        "heartbeats": {k: round(a, 3) for k, a in sorted(ages.items())},
+        "stale_after_s": timeout_s,
+    }
+    return (200 if ok else 503), body
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-obs"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:   # scrapes must not spam logs
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:           # noqa: N802 (stdlib API name)
+        from . import prometheus_from_snapshot, snapshot
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                text = prometheus_from_snapshot(snapshot())
+                self._send(200, text.encode(), _PROM_CONTENT_TYPE)
+            elif path == "/metrics.json":
+                self._send(200, json.dumps(snapshot()).encode(),
+                           "application/json")
+            elif path in ("/healthz", "/readyz"):
+                code, body = health_payload(
+                    ready=(path == "/readyz"),
+                    timeout_s=self.server.heartbeat_timeout_s)
+                self._send(code, json.dumps(body).encode(),
+                           "application/json")
+            else:
+                self._send(404, b'{"error": "not found"}',
+                           "application/json")
+        except BrokenPipeError:         # scraper went away mid-reply
+            pass
+        except Exception as e:          # a scrape must never kill serving
+            try:
+                self._send(500, json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode(),
+                    "application/json")
+            except Exception:
+                pass
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True               # per-request handler threads
+    heartbeat_timeout_s = DEFAULT_HEARTBEAT_TIMEOUT_S
+
+
+class MetricsServer:
+    """One bound endpoint + its serve-forever daemon thread."""
+
+    def __init__(self, port: int,
+                 heartbeat_timeout_s: float = DEFAULT_HEARTBEAT_TIMEOUT_S):
+        # localhost ONLY — see module docstring's safety posture
+        self._httpd = _Server(("127.0.0.1", int(port)), _Handler)
+        self._httpd.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="lightgbm-tpu-metrics-server", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_lock = threading.Lock()
+_server: Optional[MetricsServer] = None
+# ExitStack so shutdown composes with the crashed-run export
+# guarantees: atexit closes the stack, the stack stops the server
+_exit_stack = contextlib.ExitStack()
+atexit.register(_exit_stack.close)
+
+
+def server() -> Optional[MetricsServer]:
+    return _server
+
+
+def start_server(port: int,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 ) -> Optional[MetricsServer]:
+    """Start (or return) the process metrics endpoint. ``port=0`` binds
+    an ephemeral port (tests); the config path only calls this with
+    ``tpu_metrics_port > 0``. Idempotent and process-global: a second
+    DIFFERENT port warns and keeps the first, while an EXPLICIT
+    ``heartbeat_timeout_s`` (None = keep current / default) applies to
+    the live server in place — a later Config's tpu_heartbeat_timeout
+    must not be silently dropped, nor an unset one clobber an earlier
+    explicit choice. A port already in use warns and returns None —
+    the training/serving run continues without live exposition rather
+    than crashing."""
+    from ..utils import log
+    global _server
+    with _lock:
+        if _server is not None:
+            if port not in (0, _server.port):
+                log.warning(
+                    f"tpu_metrics_port={port} ignored: the metrics "
+                    f"server is already live on {_server.port} "
+                    f"(process-global; restart to move it)")
+            if heartbeat_timeout_s is not None:
+                _server._httpd.heartbeat_timeout_s = float(
+                    heartbeat_timeout_s)
+            return _server
+        try:
+            srv = MetricsServer(
+                port,
+                heartbeat_timeout_s=(DEFAULT_HEARTBEAT_TIMEOUT_S
+                                     if heartbeat_timeout_s is None
+                                     else heartbeat_timeout_s))
+        except OSError as e:
+            log.warning(
+                f"tpu_metrics_port={port}: cannot bind the metrics "
+                f"endpoint ({e}); live exposition disabled for this "
+                f"run (JSONL dumps and Booster.metrics() still work)")
+            return None
+        _server = srv
+        _exit_stack.callback(stop_server)
+        log.info(f"metrics endpoint live at {srv.url}/metrics "
+                 f"(localhost only)")
+        return srv
+
+
+def stop_server() -> None:
+    """Stop the endpoint (idempotent; safe from atexit)."""
+    global _server
+    with _lock:
+        srv, _server = _server, None
+    if srv is not None:
+        try:
+            srv.stop()
+        except Exception:
+            pass

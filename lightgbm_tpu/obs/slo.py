@@ -1,0 +1,393 @@
+"""Windowed SLIs and SLO evaluation: rolling quantiles over a time ring.
+
+PR 4's registry is *passive* — cumulative counters and histograms you
+snapshot after the fact. A load balancer probing ``/readyz`` or an
+alert on serving latency needs *windowed* signals: "p99 predict latency
+over the last five minutes", not "p99 since process start". The pieces:
+
+- :class:`SlidingHistogram` — a ring of time-bucketed sub-histograms
+  (same value-bucket ladder the cumulative :class:`~.metrics.Histogram`
+  uses). Each ``observe`` lands in the ring slot for its time bucket;
+  slots are lazily recycled as the clock advances, so memory is
+  ``O(slots × value_buckets)`` forever. ``quantile(q, window_s)``
+  aggregates the live slots and interpolates inside the selected value
+  bucket — estimates are within one value-bucket width of the exact
+  windowed percentile (tests pin this against ``numpy.percentile``).
+- :class:`SlidingCounter` — the same ring for counts, giving windowed
+  rates/ratios (error ratio, cache hit ratio).
+- :class:`SloTracker` — feeds the watched metric names (wired under the
+  EXISTING span/histogram names — ``predict/call``, ``train/round`` —
+  so the SLI and the cumulative metric can never measure different
+  events), derives SLO gauges into the registry on :meth:`evaluate`,
+  and compares them against configured thresholds: a breach flips the
+  ``slo.breached{slo=...}`` gauge to 1 and increments the
+  ``slo.breaches{slo=...}`` counter on each transition into breach.
+
+Off by default (``obs.enable(slo=True)`` / ``tpu_metrics_port`` /
+``tpu_slo_*`` knobs turn it on); when off, the hot-path cost is the
+metrics pillar's existing one-bool check — the feed call sites are
+never reached. Every method takes an optional ``now`` (monotonic
+seconds) so tests drive the clock deterministically.
+
+SLO state is process-local by design: windows describe *this
+process's* recent behavior, so it does not ride checkpoints
+(``obs.export_state`` excludes ``slo.*``/``heartbeat.*``), unlike the
+cumulative metrics that resume bit-exact.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Tuple
+
+from .metrics import DEFAULT_BUCKETS, registry
+
+__all__ = ["SlidingHistogram", "SlidingCounter", "SloTracker",
+           "tracker", "enable", "enabled", "reset", "feed_hist",
+           "feed_count", "evaluate", "DEFAULT_WINDOW_S", "DEFAULT_SLOTS"]
+
+# 5-minute default window in 10 s slots: the Prometheus-default scrape
+# cadence (15 s) sees each slot a few times before it recycles
+DEFAULT_WINDOW_S = 300.0
+DEFAULT_SLOTS = 30
+
+
+class _TimeRing:
+    """Shared ring bookkeeping: ``slots`` recycled sub-accumulators,
+    each covering ``bucket_s = window_s / slots`` of wall time. A slot
+    is valid for a window ending at ``now`` iff its epoch (absolute
+    time-bucket index) is within the trailing window."""
+
+    def __init__(self, window_s: float, slots: int):
+        if window_s <= 0 or slots <= 0:
+            raise ValueError("window_s and slots must be positive")
+        self.window_s = float(window_s)
+        self.slots = int(slots)
+        self.bucket_s = self.window_s / self.slots
+        self._epochs = [-1] * self.slots
+        self._lock = threading.Lock()
+
+    def _slot_for(self, now: float) -> int:
+        """Return the ring index for ``now``, recycling the slot if a
+        previous epoch still occupies it. Caller holds the lock."""
+        epoch = int(now // self.bucket_s)
+        s = epoch % self.slots
+        if self._epochs[s] != epoch:
+            self._clear_slot(s)
+            self._epochs[s] = epoch
+        return s
+
+    def _valid_slots(self, window_s: Optional[float],
+                     now: float) -> List[int]:
+        """Ring indices whose epoch falls inside the trailing window
+        ``(now - window_s, now]``. Caller holds the lock."""
+        w = self.window_s if window_s is None else min(float(window_s),
+                                                      self.window_s)
+        epoch_now = int(now // self.bucket_s)
+        n_back = max(1, int(-(-w // self.bucket_s)))   # ceil
+        return [s for s, e in enumerate(self._epochs)
+                if e >= 0 and epoch_now - e < n_back]
+
+    def _clear_slot(self, s: int) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class SlidingHistogram(_TimeRing):
+    """Rolling distribution: time ring of value-bucket count vectors."""
+
+    def __init__(self, bounds: Optional[Tuple[float, ...]] = None,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 slots: int = DEFAULT_SLOTS):
+        b = tuple(bounds or DEFAULT_BUCKETS)
+        if b[-1] != float("inf"):
+            b = b + (float("inf"),)
+        self.bounds = b
+        super().__init__(window_s, slots)
+        self._counts = [[0] * len(b) for _ in range(self.slots)]
+
+    def _clear_slot(self, s: int) -> None:
+        self._counts[s] = [0] * len(self.bounds)
+
+    def observe(self, v: float, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        b = bisect_left(self.bounds, float(v))
+        with self._lock:
+            self._counts[self._slot_for(now)][b] += 1
+
+    def _window_counts(self, window_s: Optional[float],
+                       now: float) -> List[int]:
+        with self._lock:
+            agg = [0] * len(self.bounds)
+            for s in self._valid_slots(window_s, now):
+                row = self._counts[s]
+                for i in range(len(agg)):
+                    agg[i] += row[i]
+            return agg
+
+    def count(self, window_s: Optional[float] = None,
+              now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        return sum(self._window_counts(window_s, now))
+
+    def quantile(self, q: float, window_s: Optional[float] = None,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Windowed quantile estimate (``q`` in [0, 1]); None when the
+        window holds no observations. Linear interpolation inside the
+        selected value bucket bounds the error to one bucket width; the
+        open-ended +Inf bucket degrades to its finite lower bound (the
+        ladder tops out at 60 s — minutes-long predict calls saturate
+        rather than extrapolate)."""
+        return self.quantiles((q,), window_s=window_s, now=now)[0]
+
+    def quantiles(self, qs, window_s: Optional[float] = None,
+                  now: Optional[float] = None) -> List[Optional[float]]:
+        """Several quantiles from ONE ring aggregation (one lock hold
+        per scrape instead of one per percentile — the scrape path
+        contends with ``observe`` on the hot predict path)."""
+        now = time.monotonic() if now is None else now
+        counts = self._window_counts(window_s, now)
+        total = sum(counts)
+        if total == 0:
+            return [None] * len(qs)
+        out: List[Optional[float]] = []
+        for q in qs:
+            target = max(0.0, min(1.0, float(q))) * total
+            cum = 0
+            value: Optional[float] = None
+            for i, c in enumerate(counts):
+                if c == 0:
+                    continue
+                if cum + c >= target:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = self.bounds[i]
+                    if hi == float("inf"):
+                        value = self.bounds[i - 1] if i > 0 else 0.0
+                    else:
+                        value = lo + (hi - lo) * ((target - cum) / c)
+                    break
+                cum += c
+            out.append(value)
+        return out
+
+
+class SlidingCounter(_TimeRing):
+    """Rolling sum: time ring of per-slot float accumulators."""
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 slots: int = DEFAULT_SLOTS):
+        super().__init__(window_s, slots)
+        self._sums = [0.0] * self.slots
+
+    def _clear_slot(self, s: int) -> None:
+        self._sums[s] = 0.0
+
+    def inc(self, n: float = 1.0, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._sums[self._slot_for(now)] += float(n)
+
+    def total(self, window_s: Optional[float] = None,
+              now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return sum(self._sums[s]
+                       for s in self._valid_slots(window_s, now))
+
+
+# ---------------------------------------------------------------------------
+# the tracker: watched names -> windows -> derived SLO gauges
+# ---------------------------------------------------------------------------
+# histogram feeds ride the EXISTING span names so the SLI and the
+# cumulative histogram measure the same events by construction
+WATCHED_HISTOGRAMS = ("predict/call", "train/round")
+WATCHED_COUNTERS = ("predict.requests", "predict.errors",
+                    "predict.stack_cache_hits",
+                    "predict.stack_cache_misses")
+# threshold key -> the SLI gauge it compares against; unknown keys are
+# rejected at enable time (a typo'd threshold must not silently watch
+# the wrong signal)
+THRESHOLD_SLIS = {"predict_p99_ms": "slo.predict_p99_ms",
+                  "error_ratio": "slo.error_ratio"}
+
+
+class SloTracker:
+    """Windowed SLI state + threshold evaluation for one process.
+
+    ``thresholds`` keys (each 0/absent = no threshold, gauge-only):
+
+    - ``predict_p99_ms`` — breach when the rolling predict p99 exceeds
+      this many milliseconds (``tpu_slo_predict_p99_ms``);
+    - ``error_ratio`` — breach when windowed
+      ``predict.errors / predict.requests`` exceeds this fraction
+      (``tpu_slo_error_ratio``).
+    """
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 slots: int = DEFAULT_SLOTS,
+                 thresholds: Optional[Dict[str, float]] = None):
+        self.window_s = float(window_s)
+        self.hists = {name: SlidingHistogram(window_s=window_s,
+                                             slots=slots)
+                      for name in WATCHED_HISTOGRAMS}
+        self.counters = {name: SlidingCounter(window_s=window_s,
+                                              slots=slots)
+                         for name in WATCHED_COUNTERS}
+        self._breached: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+        self.thresholds: Dict[str, float] = {}
+        for k, v in (thresholds or {}).items():
+            self.set_threshold(k, v)
+
+    def set_threshold(self, key: str, value) -> None:
+        """Add/replace one SLO threshold; unknown keys are rejected
+        loudly (<=0 values are ignored — the config's "no threshold").
+        Locked against evaluate(): a mid-run Config can add a
+        threshold while a scrape thread iterates them."""
+        if key not in THRESHOLD_SLIS:
+            from ..utils import log
+            log.warning(f"unknown SLO threshold {key!r} ignored "
+                        f"(known: {sorted(THRESHOLD_SLIS)})")
+            return
+        if value and float(value) > 0:
+            with self._lock:
+                self.thresholds[key] = float(value)
+
+    # -- feeds (called from obs.span/inc/observe when slo is on) -------
+    def feed_hist(self, name: str, value: float,
+                  now: Optional[float] = None) -> None:
+        h = self.hists.get(name)
+        if h is not None:
+            h.observe(value, now=now)
+
+    def feed_count(self, name: str, n: float = 1.0,
+                   now: Optional[float] = None) -> None:
+        c = self.counters.get(name)
+        if c is not None:
+            c.inc(n, now=now)
+
+    # -- evaluation ----------------------------------------------------
+    def compute(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Current SLI values (None where the window is empty) without
+        touching the registry."""
+        now = time.monotonic() if now is None else now
+        p50, p95, p99 = self.hists["predict/call"].quantiles(
+            (0.50, 0.95, 0.99), now=now)
+        r50, r99 = self.hists["train/round"].quantiles(
+            (0.50, 0.99), now=now)
+
+        def ms(v):
+            return None if v is None else v * 1000.0
+        requests = self.counters["predict.requests"].total(now=now)
+        errors = self.counters["predict.errors"].total(now=now)
+        hits = self.counters["predict.stack_cache_hits"].total(now=now)
+        misses = self.counters[
+            "predict.stack_cache_misses"].total(now=now)
+        out: Dict[str, Any] = {
+            "slo.predict_p50_ms": ms(p50),
+            "slo.predict_p95_ms": ms(p95),
+            "slo.predict_p99_ms": ms(p99),
+            "slo.round_p50_s": r50,
+            "slo.round_p99_s": r99,
+            "slo.error_ratio": (errors / requests if requests else None),
+            "predict.cache_hit_ratio": (hits / (hits + misses)
+                                        if (hits + misses) else None),
+            # queue-depth placeholder: the async micro-batching queue
+            # (ROADMAP item 2) will own this; exported now so dashboards
+            # can wire the panel before the queue exists
+            "slo.queue_depth": 0.0,
+        }
+        return out
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Refresh the SLO gauges in the process registry and run the
+        threshold comparisons. Called before every snapshot/scrape (one
+        evaluation period == one scrape), or directly."""
+        now = time.monotonic() if now is None else now
+        slis = self.compute(now=now)
+        reg = registry()
+        for name, v in slis.items():
+            if v is not None:
+                reg.gauge(name).set(v)
+            elif reg.get(name) is not None:
+                # the window drained: a frozen last-value gauge would
+                # read as live forever — drop it so the exposition says
+                # "no data" instead of "still 800 ms"
+                reg.reset(prefix=name, kind="gauge")
+        with self._lock:
+            for key, limit in self.thresholds.items():
+                current = slis.get(THRESHOLD_SLIS[key])
+                breached = current is not None and current > limit
+                reg.gauge("slo.breached", slo=key).set(
+                    1.0 if breached else 0.0)
+                if breached and not self._breached.get(key, False):
+                    reg.counter("slo.breaches", slo=key).inc()
+                self._breached[key] = breached
+        return slis
+
+
+# ---------------------------------------------------------------------------
+# process-wide singleton + module-level funnels (obs/__init__ calls these)
+# ---------------------------------------------------------------------------
+_lock = threading.Lock()
+_tracker: Optional[SloTracker] = None
+
+
+def tracker() -> Optional[SloTracker]:
+    return _tracker
+
+
+def enabled() -> bool:
+    return _tracker is not None
+
+
+def enable(window_s: Optional[float] = None,
+           thresholds: Optional[Dict[str, float]] = None,
+           slots: int = DEFAULT_SLOTS) -> SloTracker:
+    """Create (or update) the process tracker. Enable-only and
+    additive, like the rest of the obs config wiring: a later enable
+    merges thresholds into the live tracker instead of dropping its
+    window state; a DIFFERENT window on a live tracker warns and keeps
+    the first (the rings are sized at creation)."""
+    global _tracker
+    with _lock:
+        if _tracker is None:
+            _tracker = SloTracker(
+                window_s=window_s or DEFAULT_WINDOW_S, slots=slots,
+                thresholds=thresholds)
+        else:
+            if window_s and float(window_s) != _tracker.window_s:
+                from ..utils import log
+                log.warning(
+                    f"tpu_slo_window_s={window_s} ignored: SLO windows "
+                    f"are already sized at {_tracker.window_s:g}s "
+                    f"(process-global; restart to resize)")
+            for k, v in (thresholds or {}).items():
+                _tracker.set_threshold(k, v)
+        return _tracker
+
+
+def reset() -> None:
+    """Drop the tracker (window state AND thresholds). Tests only."""
+    global _tracker
+    with _lock:
+        _tracker = None
+
+
+def feed_hist(name: str, value: float,
+              now: Optional[float] = None) -> None:
+    t = _tracker
+    if t is not None:
+        t.feed_hist(name, value, now=now)
+
+
+def feed_count(name: str, n: float = 1.0,
+               now: Optional[float] = None) -> None:
+    t = _tracker
+    if t is not None:
+        t.feed_count(name, n, now=now)
+
+
+def evaluate(now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+    t = _tracker
+    return None if t is None else t.evaluate(now=now)

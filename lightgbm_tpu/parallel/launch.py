@@ -159,8 +159,21 @@ def run_worker(params: Dict, data_fn: Callable[[int, int], ShardSpec],
     cat_idx = ds._resolve_categorical(
         ds._resolve_feature_names(shard.data.shape[1]))
     ds.bin_mappers = sync_bin_mappers(shard.data, params, cat_idx)
-    return lgb.train(params, ds, num_boost_round=num_boost_round,
-                     resume_from=resume_from)
+    bst = lgb.train(params, ds, num_boost_round=num_boost_round,
+                    resume_from=resume_from)
+    # per-rank metrics for the gang-wide view (obs/aggregate.py): each
+    # worker appends its rank-tagged snapshot; the train_distributed
+    # driver merges them after the gang joins. Best-effort — a full
+    # disk must not fail a training run that already succeeded
+    rank_dir = str(params.get("tpu_metrics_rank_dir") or "").strip()
+    if rank_dir:
+        from ..obs.aggregate import dump_rank_snapshot
+        try:
+            dump_rank_snapshot(rank_dir, rank)
+        except Exception as e:
+            log.warning(f"tpu_metrics_rank_dir: cannot write rank "
+                        f"{rank} snapshot under {rank_dir!r}: {e}")
+    return bst
 
 
 def _spawn_main(rank, nproc, port, params, data_fn, num_boost_round,
@@ -351,6 +364,25 @@ def train_distributed(params: Dict,
             log.warning(f"resume=False: cleared {cleared} stale "
                         f"checkpoint(s) from {ckpt_dir}")
 
+    # fresh run claiming a rank-metrics dir: stale rank_*.jsonl from a
+    # previous (possibly larger) gang would otherwise merge as live
+    # members — yesterday's rank_3 joining today's 2-rank gang view
+    rank_dir = str(params.get("tpu_metrics_rank_dir") or "").strip()
+    if rank_dir and resume_from is None:
+        import glob as _glob
+        import os as _os
+        stale = [p for pat in ("rank_*.jsonl", "merged.jsonl")
+                 for p in _glob.glob(_os.path.join(rank_dir, pat))]
+        for p in stale:
+            try:
+                _os.remove(p)
+            except OSError:
+                pass
+        if stale:
+            log.warning(f"tpu_metrics_rank_dir {rank_dir} held "
+                        f"{len(stale)} snapshot file(s) from a "
+                        f"previous run; cleared for this fresh run")
+
     attempt = 0           # restart attempts consumed (not bind retries)
     while True:
         result = None
@@ -408,6 +440,31 @@ def train_distributed(params: Dict,
             + f"on a fresh port after {delay:.1f}s backoff")
         import time as _time
         _time.sleep(delay)
+
+    # gang-wide metrics view: merge the per-rank snapshots the workers
+    # dumped (counters sum, gauges latest, histograms bucket-add) into
+    # <dir>/merged.jsonl and surface the straggler gauge on the driver
+    rank_dir = str(params.get("tpu_metrics_rank_dir") or "").strip()
+    if rank_dir:
+        from ..obs.aggregate import merge_rank_dir
+        try:
+            merged = merge_rank_dir(rank_dir)
+            if merged is None:
+                log.warning(f"tpu_metrics_rank_dir={rank_dir!r}: no "
+                            f"rank snapshots to merge")
+            else:
+                spread = next(
+                    (m.get("value") for m in merged["metrics"]
+                     if m.get("name") == "dist.round_time_spread"),
+                    None)
+                log.info(
+                    f"merged {len(merged.get('merged_from_ranks', []))}"
+                    f" rank snapshot(s) into {rank_dir}/merged.jsonl"
+                    + (f" (round_time_spread={spread:.2f})"
+                       if spread else ""))
+        except Exception as e:
+            log.warning(f"tpu_metrics_rank_dir: merge under "
+                        f"{rank_dir!r} failed: {e}")
 
     import lightgbm_tpu as lgb
     bst = lgb.Booster(model_str=bst_str)

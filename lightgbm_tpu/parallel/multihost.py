@@ -75,9 +75,15 @@ def init_multihost(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
+    from .. import obs
     for conn_attempt in range(connect_retries + 1):
         try:
-            jax.distributed.initialize(**kwargs)
+            # forced span: gang-join latency is restart-loop telemetry
+            # (like the forced connect-retry counter below) and fires
+            # before any Config can flip tpu_metrics on
+            with obs.span("multihost/init", force=True,
+                          attempt=conn_attempt):
+                jax.distributed.initialize(**kwargs)
             break
         except (RuntimeError, TimeoutError, ConnectionError, OSError) as e:
             transient = any(tok in str(e).lower()

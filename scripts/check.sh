@@ -88,4 +88,12 @@ print("obs " + json.dumps({
     "hist_partition": gauge("bench.hist_partition"),
 }))
 PY
+
+# perf-regression sentinel (CHECK_TREND=1 to enforce): compare the obs
+# line just appended against the trailing same-mode median; a >15%
+# iters/sec drop, compile-count jump, or peak-HBM creep FAILS the gate.
+# First run (no history) stays green — the sentinel needs >= 2 lines.
+if [[ "${CHECK_TREND:-0}" == "1" ]]; then
+  python scripts/obs_trend.py
+fi
 echo "check.sh: OK (timing + obs line logged to scripts/check_timings.log)"

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over scripts/check_timings.log obs lines.
+
+scripts/check.sh appends one machine-readable ``obs {...}`` JSON line
+per run (dots, seconds, bench iters/sec, compile requests, peak-HBM).
+This sentinel turns that log from a thing a reviewer *may* eyeball into
+a gate: compare the NEWEST run against the trailing median of the
+previous runs (same mode) and exit non-zero when a watched signal
+regressed past its threshold —
+
+- ``bench_iters_per_sec`` DOWN by more than ``--max-ips-drop``
+  (default 15%: a 20% regression must fail, run-to-run noise on the
+  tunneled chip must not);
+- ``compile_requests`` UP by more than ``--max-compile-up`` (fraction)
+  plus ``--compile-slack`` absolute requests (cold-cache runs jitter
+  by a couple);
+- ``peak_hbm_gib`` UP by more than ``--max-hbm-up``;
+- ``secs`` (suite wall clock) UP by more than ``--max-secs-up`` at a
+  non-lower dot count (fewer dots = different suite, not a slowdown).
+
+No (or not enough) history exits 0 — the first run after a wipe stays
+green. A signal missing from either side of the comparison is skipped
+(benches evolve), and malformed obs lines are warned about and
+skipped, never crash the gate.
+
+A FAILING run writes a ``trend-reject {...}`` marker (keyed on the
+entry's ts/rev/mode) back into the log, and rejected entries are
+excluded from every later baseline — re-running the gate against a
+persistent regression cannot launder the regressed numbers into the
+trailing median it is compared against.
+
+Usage (scripts/check.sh runs it behind CHECK_TREND=1):
+    python scripts/obs_trend.py [--log scripts/check_timings.log]
+        [--window 5] [--max-ips-drop 0.15] [--max-compile-up 0.5]
+        [--compile-slack 2] [--max-hbm-up 0.2] [--max-secs-up 0.35]
+Exit codes: 0 = no regression (or no history), 1 = regression, 2 = bad
+invocation (unreadable log path given explicitly).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional
+
+DEFAULT_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "check_timings.log")
+
+
+def _entry_key(entry: Dict[str, Any]) -> tuple:
+    return (entry.get("ts"), entry.get("rev"), entry.get("mode"))
+
+
+def parse_obs_lines(text: str) -> List[Dict[str, Any]]:
+    """All well-formed ``obs {...}`` entries, oldest first, minus
+    entries covered by a ``trend-reject`` marker (a previous sentinel
+    failure — they must not become baseline). Malformed entries warn
+    to stderr and are skipped."""
+    # markers are APPENDED after the entries they reject, so collect
+    # them in a first pass before flagging entries
+    rejected = set()
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("trend-reject "):
+            try:
+                rejected.add(_entry_key(
+                    json.loads(line[len("trend-reject "):])))
+            except ValueError:
+                pass
+    out: List[Dict[str, Any]] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line.startswith("obs "):
+            continue
+        try:
+            entry = json.loads(line[len("obs "):])
+            if not isinstance(entry, dict):
+                raise ValueError("not a JSON object")
+        except ValueError as e:
+            sys.stderr.write(f"obs_trend: skipping malformed obs line "
+                             f"{i} ({e})\n")
+            continue
+        entry["_rejected"] = _entry_key(entry) in rejected
+        out.append(entry)
+    return out
+
+
+def _num(entry: Dict[str, Any], key: str) -> Optional[float]:
+    v = entry.get(key)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def _median_of(history: List[Dict[str, Any]],
+               key: str) -> Optional[float]:
+    vals = [v for v in (_num(e, key) for e in history) if v is not None]
+    return statistics.median(vals) if vals else None
+
+
+def check_trend(entries: List[Dict[str, Any]], window: int,
+                max_ips_drop: float, max_compile_up: float,
+                compile_slack: float, max_hbm_up: float,
+                max_secs_up: float) -> List[str]:
+    """Regression messages for the newest entry vs the trailing median
+    of up to ``window`` earlier same-mode entries; [] = green."""
+    if not entries:
+        return []
+    newest = entries[-1]
+    mode = newest.get("mode")
+    # rejected entries (previous sentinel failures) never become
+    # baseline — a persistent regression re-run N times must keep
+    # failing against the last GREEN history, not against itself
+    history = [e for e in entries[:-1]
+               if e.get("mode") == mode and not e.get("_rejected")]
+    history = history[-window:]
+    if not history:
+        return []    # first run (or first in this mode): no baseline
+    failures: List[str] = []
+
+    ips_now = _num(newest, "bench_iters_per_sec")
+    ips_med = _median_of(history, "bench_iters_per_sec")
+    if ips_now is not None and ips_med:
+        floor = ips_med * (1.0 - max_ips_drop)
+        if ips_now < floor:
+            failures.append(
+                f"bench_iters_per_sec regressed: {ips_now:.3g} < "
+                f"{floor:.3g} (trailing median {ips_med:.3g} over "
+                f"{len(history)} run(s), -{max_ips_drop:.0%} allowed)")
+
+    comp_now = _num(newest, "compile_requests")
+    comp_med = _median_of(history, "compile_requests")
+    if comp_now is not None and comp_med is not None:
+        ceil = comp_med * (1.0 + max_compile_up) + compile_slack
+        if comp_now > ceil:
+            failures.append(
+                f"compile_requests regressed: {comp_now:g} > {ceil:g} "
+                f"(trailing median {comp_med:g}; a compile-count jump "
+                f"is a warm-path recompile leak)")
+
+    hbm_now = _num(newest, "peak_hbm_gib")
+    hbm_med = _median_of(history, "peak_hbm_gib")
+    if hbm_now is not None and hbm_med:
+        ceil = hbm_med * (1.0 + max_hbm_up)
+        if hbm_now > ceil:
+            failures.append(
+                f"peak_hbm_gib regressed: {hbm_now:.3g} > {ceil:.3g} "
+                f"(trailing median {hbm_med:.3g})")
+
+    secs_now = _num(newest, "secs")
+    secs_med = _median_of(history, "secs")
+    dots_now = _num(newest, "dots")
+    dots_med = _median_of(history, "dots")
+    if (secs_now is not None and secs_med
+            and dots_now is not None and dots_med is not None
+            and dots_now >= dots_med):
+        ceil = secs_med * (1.0 + max_secs_up)
+        if secs_now > ceil:
+            failures.append(
+                f"suite wall clock regressed: {secs_now:g}s > "
+                f"{ceil:.0f}s (trailing median {secs_med:g}s at "
+                f"dots>={dots_med:g})")
+    return failures
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="perf-regression sentinel over check_timings.log "
+                    "obs lines (see module docstring)")
+    ap.add_argument("--log", default=DEFAULT_LOG)
+    ap.add_argument("--window", type=int, default=5,
+                    help="trailing same-mode runs the median is over")
+    ap.add_argument("--max-ips-drop", type=float, default=0.15)
+    ap.add_argument("--max-compile-up", type=float, default=0.5)
+    ap.add_argument("--compile-slack", type=float, default=2.0)
+    ap.add_argument("--max-hbm-up", type=float, default=0.2)
+    ap.add_argument("--max-secs-up", type=float, default=0.35)
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.log) as f:
+            text = f.read()
+    except OSError as e:
+        if args.log != DEFAULT_LOG:
+            sys.stderr.write(f"obs_trend: cannot read {args.log}: "
+                             f"{e}\n")
+            return 2
+        print("obs_trend: no timings log yet; nothing to compare")
+        return 0
+
+    entries = parse_obs_lines(text)
+    if len(entries) < 2:
+        print(f"obs_trend: {len(entries)} obs line(s) in {args.log}; "
+              f"need >= 2 for a trend — OK")
+        return 0
+    failures = check_trend(entries, args.window, args.max_ips_drop,
+                           args.max_compile_up, args.compile_slack,
+                           args.max_hbm_up, args.max_secs_up)
+    if failures:
+        for msg in failures:
+            print(f"obs_trend: REGRESSION — {msg}")
+        print(f"obs_trend: newest run vs trailing median FAILED "
+              f"({len(failures)} signal(s)); see {args.log}")
+        # mark the failed entry so re-runs cannot launder it into the
+        # baseline (best-effort: a read-only log still fails the gate)
+        newest = entries[-1]
+        if not newest.get("_rejected"):
+            try:
+                with open(args.log, "a") as f:
+                    f.write("trend-reject " + json.dumps(
+                        {"ts": newest.get("ts"),
+                         "rev": newest.get("rev"),
+                         "mode": newest.get("mode")}) + "\n")
+            except OSError as e:
+                sys.stderr.write(f"obs_trend: cannot write reject "
+                                 f"marker: {e}\n")
+        return 1
+    print("obs_trend: newest run within thresholds of the trailing "
+          "median — OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

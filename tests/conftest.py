@@ -90,6 +90,46 @@ def multiprocess_collectives():
         f"collectives returned wrong data: {results}"
 
 
+@pytest.fixture(autouse=True)
+def _obs_registry_guard(request):
+    """Snapshot-and-restore the PROCESS-WIDE observability state around
+    every obs-flavored test (module name contains ``obs`` or ``slo``).
+
+    The obs registry, SLO tracker, trace buffer and metrics server are
+    process globals; without this guard an obs test could leak an
+    enabled registry into the rest of tier-1 (timing) or inherit
+    forced counters from earlier tests (restart.attempts and friends),
+    making assertions order-dependent. Non-obs modules pay one string
+    check."""
+    name = request.module.__name__
+    if "obs" not in name and "slo" not in name:
+        yield
+        return
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import metrics as _om
+    from lightgbm_tpu.obs import server as _osrv
+    from lightgbm_tpu.obs import slo as _oslo
+    from lightgbm_tpu.obs import tracing as _otr
+    reg = _om.registry()
+    # VALUE snapshot, not an object-reference copy: the test may
+    # mutate a pre-existing metric in place (forced counters), and the
+    # restore must bring the old values back, not the shared objects
+    saved_state = reg.export_state()
+    saved_enabled = obs.enabled()
+    saved_dir = _otr._dir
+    try:
+        yield
+    finally:
+        obs.disable()
+        obs.reset()
+        _oslo.reset()
+        _osrv.stop_server()
+        _otr._dir = saved_dir
+        reg.import_state(saved_state)
+        if saved_enabled:
+            obs.enable(metrics=True)
+
+
 def pytest_collection_modifyitems(config, items):
     if not TPU_MODE or jax.device_count() >= 8:
         return

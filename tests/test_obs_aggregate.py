@@ -1,0 +1,253 @@
+"""Per-rank metrics aggregation (lightgbm_tpu/obs/aggregate.py).
+
+What these tests pin:
+
+* **Associativity** — the snapshot merge is a fold that must converge
+  regardless of grouping: ``(A ⊕ B) ⊕ C == A ⊕ (B ⊕ C)`` across
+  counters, gauges, labeled metrics and histograms (including the
+  mismatched-bucket-layout degradation).
+* **Semantics** — counters SUM across ranks; gauges keep the latest
+  stamp (ties break deterministically on value); histograms bucket-add
+  with min-of-mins/max-of-maxes.
+* **Straggler gauge** — ``dist.round_time_spread`` = max/min of
+  per-rank mean round time; an even gang reads 1.0.
+* **Rank-file plumbing** — rank dumps land in rank_<r>.jsonl, corrupt
+  files are skipped, the merged view lands in merged.jsonl.
+* **End-to-end** (capability-gated like every multi-process gang) — a
+  2-process ``train_distributed`` run whose merged counters equal the
+  sum of per-rank counters and whose spread gauge is finite.
+"""
+import copy
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.obs import aggregate as agg
+
+
+def _counter(name, value, updated=1.0, **labels):
+    m = {"name": name, "type": "counter", "value": value,
+         "updated_monotonic": updated}
+    if labels:
+        m["labels"] = {k: str(v) for k, v in labels.items()}
+    return m
+
+
+def _gauge(name, value, updated=1.0):
+    return {"name": name, "type": "gauge", "value": value,
+            "updated_monotonic": updated}
+
+
+def _hist(name, buckets, sum_=None, mn=None, mx=None, updated=1.0):
+    count = sum(c for _b, c in buckets)
+    return {"name": name, "type": "histogram", "count": count,
+            "sum": count * 0.1 if sum_ is None else sum_,
+            "min": mn, "max": mx, "buckets": [list(b) for b in buckets],
+            "updated_monotonic": updated}
+
+
+def _snap(rank, metrics, ts=100.0):
+    return {"schema": "lightgbm-tpu-metrics-v1", "ts": ts,
+            "rank": rank, "metrics": copy.deepcopy(metrics)}
+
+
+def _canon(snap):
+    """Comparable form: metrics keyed by identity, envelope ranks."""
+    out = {}
+    for m in snap["metrics"]:
+        key = (m["name"], m.get("type"),
+               tuple(sorted((m.get("labels") or {}).items())))
+        out[key] = {k: v for k, v in m.items()
+                    if k != "updated_monotonic"}
+    return out, sorted(snap.get("merged_from_ranks", []))
+
+
+A = _snap(0, [
+    _counter("train.iterations", 10, updated=5.0),
+    _counter("predict.requests", 3, updated=2.0, model="a"),
+    _gauge("hbm.bytes_in_use", 100.0, updated=1.0),
+    _hist("train/round", [[0.1, 2], [1.0, 1], ["+Inf", 0]],
+          sum_=0.5, mn=0.05, mx=0.9),
+])
+B = _snap(1, [
+    _counter("train.iterations", 20, updated=6.0),
+    _gauge("hbm.bytes_in_use", 300.0, updated=9.0),
+    _hist("train/round", [[0.1, 1], [1.0, 3], ["+Inf", 1]],
+          sum_=3.0, mn=0.02, mx=5.0),
+    _counter("checkpoint.saves", 2, updated=1.0),
+])
+C = _snap(2, [
+    _counter("train.iterations", 5, updated=2.5),
+    _counter("predict.requests", 4, updated=9.0, model="a"),
+    _gauge("hbm.bytes_in_use", 200.0, updated=9.0),
+    _hist("train/round", [[0.1, 0], [1.0, 2], ["+Inf", 0]],
+          sum_=1.0, mn=0.4, mx=0.6),
+])
+
+
+def test_merge_is_associative_across_groupings():
+    left = agg.merge_snapshots([agg.merge_snapshots([A, B]), C])
+    right = agg.merge_snapshots([A, agg.merge_snapshots([B, C])])
+    flat = agg.merge_snapshots([A, B, C])
+    assert _canon(left) == _canon(right) == _canon(flat)
+    assert _canon(left)[1] == [0, 1, 2]
+
+
+def test_merge_semantics_counters_gauges_histograms():
+    merged, _ranks = _canon(agg.merge_snapshots([A, B, C]))
+    cnt = merged[("train.iterations", "counter", ())]
+    assert cnt["value"] == 35                      # counters SUM
+    lab = merged[("predict.requests", "counter", (("model", "a"),))]
+    assert lab["value"] == 7                       # per-label-set sums
+    g = merged[("hbm.bytes_in_use", "gauge", ())]
+    # latest updated wins; the B-vs-C tie at updated=9.0 breaks on the
+    # larger value (deterministic total order keeps the fold a fold)
+    assert g["value"] == 300.0
+    h = merged[("train/round", "histogram", ())]
+    assert h["count"] == 10 and h["sum"] == pytest.approx(4.5)
+    assert h["min"] == 0.02 and h["max"] == 5.0
+    assert h["buckets"] == [[0.1, 3], [1.0, 6], ["+Inf", 1]]
+    # a metric present on one rank only passes through
+    assert merged[("checkpoint.saves", "counter", ())]["value"] == 2
+
+
+def test_mismatched_histogram_layouts_degrade_associatively():
+    D = _snap(3, [_hist("train/round", [[0.5, 4], ["+Inf", 0]],
+                        sum_=1.0, mn=0.1, mx=0.4)])
+    left = agg.merge_snapshots([agg.merge_snapshots([A, D]), C])
+    right = agg.merge_snapshots([A, agg.merge_snapshots([D, C])])
+    assert _canon(left) == _canon(right)
+    h = _canon(left)[0][("train/round", "histogram", ())]
+    assert h["buckets"] is None                    # layout conflict
+    assert h["count"] == 9                         # scalars still fold
+
+
+def test_gauge_latest_uses_wall_rebased_stamps_across_hosts():
+    """Per-process monotonic clocks are per-boot epochs: a host up 30
+    days must not win every latest-gauge tie against a freshly
+    rebooted one. Leaf snapshots rebase updated stamps to wall clock
+    via their ts/monotonic envelope pair before folding."""
+    # host A: booted long ago (monotonic ~2.6e6), stamped 100 s before
+    # its snapshot; host B: fresh boot (monotonic 50), stamped 1 s
+    # before its LATER snapshot — B's value is genuinely newer
+    host_a = {"schema": "lightgbm-tpu-metrics-v1", "ts": 1000.0,
+              "monotonic": 2_600_000.0, "rank": 0,
+              "metrics": [_gauge("hbm.bytes_in_use", 111.0,
+                                 updated=2_599_900.0)]}
+    host_b = {"schema": "lightgbm-tpu-metrics-v1", "ts": 1050.0,
+              "monotonic": 50.0, "rank": 1,
+              "metrics": [_gauge("hbm.bytes_in_use", 222.0,
+                                 updated=49.0)]}
+    merged, _ = _canon(agg.merge_snapshots([host_a, host_b]))
+    # raw monotonic compare would keep host A's stale 111.0
+    assert merged[("hbm.bytes_in_use", "gauge", ())]["value"] == 222.0
+    # grouping order doesn't change the outcome (rebase stays a fold)
+    one = agg.merge_snapshots([agg.merge_snapshots([host_a]), host_b])
+    assert _canon(one)[0][("hbm.bytes_in_use", "gauge",
+                           ())]["value"] == 222.0
+
+
+def test_degraded_histogram_renders_in_prometheus_exposition():
+    """A merged snapshot with buckets:null (layout-mismatch
+    degradation) must still render its scalar _sum/_count lines —
+    task=dump_metrics on a merged.jsonl must not crash."""
+    from lightgbm_tpu.obs.metrics import prometheus_from_snapshot
+    D = _snap(3, [_hist("train/round", [[0.5, 4], ["+Inf", 0]],
+                        sum_=1.0)])
+    merged = agg.merge_snapshots([A, D])
+    h = next(m for m in merged["metrics"]
+             if m["name"] == "train/round")
+    assert h["buckets"] is None
+    text = prometheus_from_snapshot(merged)
+    assert "train_round_count 7" in text
+    assert "train_round_sum 1.5" in text
+    assert "train_round_bucket" not in text
+
+
+def test_round_time_spread_and_even_gang():
+    # rank means: A 0.5/3, B 3.0/5, C 1.0/2 -> max/min = 0.6/(1/6)
+    spread = agg.round_time_spread([A, B, C])
+    assert spread == pytest.approx(0.6 / (0.5 / 3))
+    even = [_snap(r, [_hist("train/round", [[1.0, 4], ["+Inf", 0]],
+                            sum_=2.0)]) for r in range(3)]
+    assert agg.round_time_spread(even) == pytest.approx(1.0)
+    assert agg.round_time_spread([_snap(0, [])]) is None
+
+
+def test_rank_dir_dump_merge_and_corrupt_file_skip(tmp_path):
+    d = str(tmp_path)
+    for snap in (A, B, C):
+        agg.dump_rank_snapshot(d, snap["rank"], snap)
+    # a rank killed mid-write leaves garbage: skipped, not fatal
+    (tmp_path / "rank_7.jsonl").write_text("{truncated")
+    merged = agg.merge_rank_dir(d)
+    assert merged["merged_from_ranks"] == [0, 1, 2]
+    by = {m["name"]: m for m in merged["metrics"]
+          if not m.get("labels")}
+    assert by["train.iterations"]["value"] == 35
+    assert math.isfinite(by["dist.round_time_spread"]["value"])
+    # merged.jsonl written and parseable
+    lines = (tmp_path / "merged.jsonl").read_text().splitlines()
+    assert json.loads(lines[-1])["merged_from_ranks"] == [0, 1, 2]
+    # newest-line semantics: a re-dump supersedes the old rank line
+    A2 = copy.deepcopy(A)
+    A2["metrics"][0]["value"] = 100
+    agg.dump_rank_snapshot(d, 0, A2)
+    merged2 = agg.merge_rank_dir(d, write=False)
+    by2 = {m["name"]: m for m in merged2["metrics"]
+           if not m.get("labels")}
+    assert by2["train.iterations"]["value"] == 125
+
+
+def test_empty_rank_dir_returns_none(tmp_path):
+    assert agg.merge_rank_dir(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end 2-process gang (capability-gated like test_multihost)
+# ---------------------------------------------------------------------------
+def _agg_shard(rank, nproc):
+    rng = np.random.default_rng(100 + rank)
+    X = rng.normal(size=(600, 6))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    return {"data": X, "label": y}
+
+
+def test_two_process_gang_merges_rank_counters(
+        tmp_path, multiprocess_collectives):
+    from lightgbm_tpu.parallel.launch import train_distributed
+    d = str(tmp_path / "ranks")
+    os.makedirs(d)
+    # a stale rank file from a previous (larger) gang must NOT merge
+    # as a live member: the fresh-run driver clears the dir
+    agg.dump_rank_snapshot(d, 7, _snap(7, [
+        _counter("train.iterations", 999)]))
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "tpu_metrics": True, "tpu_metrics_rank_dir": d,
+              "tpu_fuse_iters": 1}
+    bst = train_distributed(params, _agg_shard, n_processes=2,
+                            num_boost_round=4, timeout=600.0)
+    assert bst.num_trees() == 4
+    snaps = agg.read_rank_snapshots(d)
+    assert {s["rank"] for s in snaps} == {0, 1}
+    merged = json.loads(
+        (tmp_path / "ranks" / "merged.jsonl").read_text()
+        .splitlines()[-1])
+    assert sorted(merged["merged_from_ranks"]) == [0, 1]
+
+    def counter_of(snap, name):
+        for m in snap["metrics"]:
+            if m["name"] == name and not m.get("labels"):
+                return m["value"]
+        return 0.0
+    per_rank = [counter_of(s, "train.iterations")
+                for s in snaps if s.get("rank") in (0, 1)]
+    assert per_rank and all(v == 4 for v in per_rank)
+    # merged counters == sum of per-rank counters (ISSUE acceptance)
+    assert counter_of(merged, "train.iterations") == sum(per_rank)
+    spread = next(m["value"] for m in merged["metrics"]
+                  if m["name"] == "dist.round_time_spread")
+    assert math.isfinite(spread) and spread >= 1.0

@@ -1,0 +1,137 @@
+"""Perf-regression sentinel (scripts/obs_trend.py).
+
+What these tests pin (ISSUE acceptance): the sentinel exits non-zero
+on a synthetic 20% iters/sec regression, zero on flat history, zero on
+empty/first-run history (so wiring it into scripts/check.sh can never
+redden a fresh clone), and skips — not crashes on — malformed lines
+and missing signals. Runs the script as a subprocess: the exit code IS
+the contract check.sh consumes.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+SCRIPT = str(pathlib.Path(__file__).resolve().parent.parent
+             / "scripts" / "obs_trend.py")
+
+
+def _obs_line(ips=10.0, compile_requests=50, peak=2.0, secs=300,
+              dots=38, mode="smoke"):
+    return "obs " + json.dumps({
+        "ts": "2026-08-03T00:00:00Z", "rev": "abc1234", "mode": mode,
+        "dots": dots, "secs": secs, "compile_requests": compile_requests,
+        "peak_hbm_gib": peak, "bench_iters_per_sec": ips,
+        "predict_programs": 3, "hist_rows_scanned": 1e8,
+        "hist_partition": 0})
+
+
+def _run(tmp_path, lines, *extra):
+    log = tmp_path / "check_timings.log"
+    log.write_text("\n".join(lines) + "\n")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--log", str(log), *extra],
+        capture_output=True, text=True)
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def test_flat_history_is_green(tmp_path):
+    rc, out = _run(tmp_path, [_obs_line(ips=10.0 + 0.02 * i)
+                              for i in range(6)])
+    assert rc == 0, out
+    assert "OK" in out
+
+
+def test_twenty_percent_ips_regression_fails(tmp_path):
+    lines = [_obs_line(ips=10.0) for _ in range(5)]
+    lines.append(_obs_line(ips=8.0))          # -20%
+    rc, out = _run(tmp_path, lines)
+    assert rc == 1, out
+    assert "bench_iters_per_sec regressed" in out
+
+
+def test_empty_and_first_run_history_stay_green(tmp_path):
+    # plain timing lines only — no obs lines at all (pre-PR-4 logs)
+    rc, out = _run(tmp_path, [
+        "2026-08-03T00:00:00Z abc1234 smoke dots=38 secs=300 rc=0"])
+    assert rc == 0, out
+    # exactly one obs line: nothing to compare against
+    rc, out = _run(tmp_path, [_obs_line()])
+    assert rc == 0, out
+    # missing log file entirely (default path semantics via --log to a
+    # nonexistent explicit path is an invocation error instead)
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--log", str(tmp_path / "nope.log")],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+
+
+def test_compile_and_hbm_regressions_fail(tmp_path):
+    base = [_obs_line() for _ in range(4)]
+    rc, out = _run(tmp_path, base + [_obs_line(compile_requests=200)])
+    assert rc == 1 and "compile_requests" in out
+    rc, out = _run(tmp_path, base + [_obs_line(peak=3.5)])
+    assert rc == 1 and "peak_hbm_gib" in out
+    # small jitter within thresholds stays green
+    rc, out = _run(tmp_path, base + [_obs_line(
+        ips=9.2, compile_requests=51, peak=2.2, secs=330)])
+    assert rc == 0, out
+
+
+def test_wall_clock_regression_needs_same_or_more_dots(tmp_path):
+    base = [_obs_line(secs=300, dots=38) for _ in range(4)]
+    rc, out = _run(tmp_path, base + [_obs_line(secs=600, dots=38)])
+    assert rc == 1 and "wall clock" in out
+    # fewer dots = a different (partial) suite, not a slowdown
+    rc, out = _run(tmp_path, base + [_obs_line(secs=600, dots=20)])
+    assert rc == 0, out
+
+
+def test_malformed_lines_and_missing_signals_are_skipped(tmp_path):
+    lines = [_obs_line() for _ in range(3)]
+    lines.insert(1, "obs {not json at all")
+    # newest line lacks the bench signal (e.g. a bench-less run)
+    newest = json.loads(lines[-1][len("obs "):])
+    del newest["bench_iters_per_sec"]
+    lines.append("obs " + json.dumps(newest))
+    rc, out = _run(tmp_path, lines)
+    assert rc == 0, out
+    assert "malformed" in out
+
+
+def test_failed_runs_cannot_launder_into_the_baseline(tmp_path):
+    """A persistent regression re-run N times must keep failing
+    against the last GREEN history: each failing run writes a
+    trend-reject marker and rejected entries never join the median."""
+    log = tmp_path / "check_timings.log"
+    lines = [_obs_line(ips=10.0) for _ in range(5)]
+    log.write_text("\n".join(lines) + "\n")
+    # regressed entries need distinct keys (ts differs per real run)
+    for i in range(4):
+        bad = json.loads(_obs_line(ips=8.0)[len("obs "):])
+        bad["ts"] = f"2026-08-03T01:00:0{i}Z"
+        with open(log, "a") as f:
+            f.write("obs " + json.dumps(bad) + "\n")
+        proc = subprocess.run(
+            [sys.executable, SCRIPT, "--log", str(log)],
+            capture_output=True, text=True)
+        # run i sees only the green 10.0 baseline — fails every time
+        assert proc.returncode == 1, (i, proc.stdout + proc.stderr)
+    assert log.read_text().count("trend-reject ") == 4
+    # a genuinely recovered run goes green again
+    with open(log, "a") as f:
+        f.write(_obs_line(ips=9.8) + "\n")
+    proc = subprocess.run([sys.executable, SCRIPT, "--log", str(log)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_modes_compare_separately(tmp_path):
+    # full-suite runs must not drag the smoke baseline (different secs
+    # scale); a smoke run is compared against smoke history only
+    lines = [_obs_line(mode="full", secs=3000, dots=96)
+             for _ in range(4)]
+    lines += [_obs_line(mode="smoke", secs=300, dots=38)]
+    lines += [_obs_line(mode="smoke", secs=310, dots=38)]
+    rc, out = _run(tmp_path, lines)
+    assert rc == 0, out
