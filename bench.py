@@ -256,6 +256,13 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="pre-snapshot gate mode (scripts/check.sh): "
                          "single window, skip plain1m + guard2")
+    ap.add_argument("--stream-rows", type=int, default=200_000,
+                    help="rows for the streamed-training probe "
+                         "(tpu_streaming=true, sharded over local "
+                         "devices when >1; docs/perf.md 'Streamed x "
+                         "sharded'). Emits stream_shards= / "
+                         "stream_rows_per_sec= / allreduce_bytes= on "
+                         "the metric line; 0 disables")
     ap.add_argument("--metrics-json", type=str, default="",
                     help="append one obs metrics-snapshot JSONL line "
                          "(docs/observability.md schema) to PATH; also "
@@ -269,6 +276,9 @@ def main():
     if args.smoke:
         args.windows = 1
         args.plain1m = args.guard2 = False
+        # keep the pre-snapshot gate fast: the streamed probe still
+        # runs (the gate is where its trajectory lands) but smaller
+        args.stream_rows = min(args.stream_rows, 100_000)
     if args.holdout is None:
         args.holdout = max(100_000, args.rows // 20)
     if args.warmup is None:
@@ -351,6 +361,36 @@ def main():
                                         measure_predict=False)
         obs.set_gauge("bench.guard2_auc", g_auc, force=True)
 
+    # streamed-training trajectory (docs/perf.md "Streamed x sharded"):
+    # a small forced-streaming train — sharded over the local devices
+    # when the platform has more than one — so BENCH_*.json carries
+    # stream_rows_per_sec / allreduce_bytes alongside the resident
+    # headline instead of an empty streamed history
+    if args.stream_rows > 0:
+        import jax
+        import lightgbm_tpu as lgb
+        ns = min(args.rows, args.stream_rows)
+        sp = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "max_bin": MAX_BIN, "learning_rate": 0.1,
+              "verbosity": -1, "tpu_streaming": "true",
+              "tpu_stream_block_rows": 1 << 16}
+        shards = max(1, jax.local_device_count())
+        if shards > 1:
+            sp["tree_learner"] = "data"
+            sp["tpu_mesh_shape"] = shards
+        s_trees = 4
+        sds = lgb.Dataset(X[:ns], label=y[:ns], params=dict(sp))
+        t0 = time.time()
+        sbst = lgb.train(sp, sds, num_boost_round=s_trees)
+        s_secs = max(time.time() - t0, 1e-9)
+        cs = sbst.engine.comm_stats
+        obs.set_gauge("bench.stream_shards", sbst.engine.R, force=True)
+        obs.set_gauge("bench.stream_rows_per_sec",
+                      ns * s_trees / s_secs, force=True)
+        obs.set_gauge("bench.stream_allreduce_bytes",
+                      cs["allreduce_bytes"], force=True)
+        del sbst, sds
+
     peak = peak_hbm_gib()
     if peak is not None:
         obs.set_gauge("bench.peak_hbm_gib", peak, force=True)
@@ -376,6 +416,17 @@ def main():
         # the structural win the partition exists for: total rows the
         # histogram scans touched (masked = n_pad x rounds)
         extras += f"; hist_rows_scanned={v:.3g}"
+    v = _snap_gauge(snap, "bench.stream_rows_per_sec")
+    if v is not None:
+        # the streamed-training trajectory: rows x trees per second on
+        # the out-of-core path, the shard count it ran at, and the
+        # per-level collective payload it moved
+        extras += (
+            f"; stream_shards="
+            f"{int(_snap_gauge(snap, 'bench.stream_shards'))}"
+            f"; stream_rows_per_sec={v:.0f}"
+            f"; allreduce_bytes="
+            f"{int(_snap_gauge(snap, 'bench.stream_allreduce_bytes'))}")
     v = _snap_gauge(snap, "bench.plain1m_iters_per_sec")
     if v is not None:
         extras += (f"; plain1m={v:.2f}@auc"

@@ -15,14 +15,18 @@ __all__ = ["GBDT", "create_boosting"]
 
 def _streaming_compatible(config) -> bool:
     """Configs StreamingGBDT.__init__ would accept (kept in sync with
-    its _no() gates; auto mode must NEVER route a config into a
-    log.fatal that the resident engine would have trained)."""
-    return (config.tree_learner == "serial"
+    its _no() gates — the drift-guard sweep in tests/test_streaming_
+    sharded.py pins the iff; auto mode must NEVER route a config into
+    a log.fatal that the resident engine would have trained).
+
+    Bagging, GOSS, quantized gradients and ``tree_learner=data`` (the
+    sharded streamed path) are streaming-supported; voting/feature
+    learners and the structured-constraint features are not."""
+    return (config.tree_learner in ("serial", "data")
             and config.boosting == "gbdt"
             and config.num_tree_per_iteration == 1
-            and str(config.data_sample_strategy) != "goss"
-            and config.bagging_fraction >= 1.0
-            and config.bagging_freq <= 0
+            # int16 per-row leaf-id state caps streamed trees
+            and int(config.num_leaves) <= 32767
             and not bool(config.linear_tree)
             and not bool(config.monotone_constraints)
             and not bool(config.interaction_constraints)
@@ -32,10 +36,6 @@ def _streaming_compatible(config) -> bool:
             and config.cegb_penalty_split <= 0
             and not bool(config.cegb_penalty_feature_coupled)
             and not bool(config.cegb_penalty_feature_lazy)
-            # explicit quantization fatals in streaming (auto-quantize
-            # is quietly demoted there, so it stays routable)
-            and not (bool(config.use_quantized_grad)
-                     and not getattr(config, "_quantize_auto", False))
             and not bool(config.forcedsplits_filename)
             and not bool(config.categorical_feature)
             and str(config.objective) not in ("lambdarank",
@@ -62,10 +62,20 @@ def _should_stream(config, train_set, fobj) -> bool:
                              hbm_bytes_limit)
     try:
         import jax
-        if jax.device_count() > 1:
-            return False        # sharded residents divide per-device
+        n_dev = jax.device_count()
+        local_dev = jax.local_device_count()
     except Exception:
         return False
+    shards = 1
+    if n_dev > 1:
+        # a mesh config: only the data-parallel learner has a streamed
+        # sharded path (each rank streams its own row shard's blocks;
+        # one packed psum per level). Other learners keep the resident
+        # engine and its own per-device sharding/guard.
+        if config.tree_learner != "data":
+            return False
+        tms = str(getattr(config, "tpu_mesh_shape", "")).strip()
+        shards = max(1, min(local_dev, int(tms) if tms else local_dev))
     limit = hbm_bytes_limit()
     if not limit:
         return False
@@ -81,7 +91,10 @@ def _should_stream(config, train_set, fobj) -> bool:
         return False
     itemsize = 2 if int(config.max_bin) > 255 else 1
     est = binned_device_bytes(n, f, itemsize)   # bins + bins_t (Pallas)
-    if est <= STREAM_HBM_FRACTION * limit:
+    # this process's data spreads over its local mesh devices: stream
+    # only when the PER-RANK shard would still blow the HBM budget —
+    # the beyond-HBM x beyond-host composition (ROADMAP item 1)
+    if est / shards <= STREAM_HBM_FRACTION * limit:
         return False
     # dataset-level gate: pandas-category / auto-detected categorical
     # bins would make StreamingGBDT fatal — keep those resident

@@ -1,5 +1,5 @@
 """Out-of-core (larger-than-HBM) boosting: host-resident bins, streamed
-level sweeps.
+level sweeps — optionally SHARDED over a data mesh (beyond one host).
 
 Closes the last scale-axis gap vs the reference (VERDICT r4 item 3):
 upstream LightGBM trains any dataset that fits host RAM/disk — its
@@ -8,16 +8,18 @@ on the accelerator (``src/io/dataset_loader.cpp``, SURVEY.md §2.1,
 UNVERIFIED — empty mount). The resident engine here (`gbdt.GBDT`)
 uploads the full binned matrix to HBM, capping trainable size at
 ~HBM/(F bytes-per-row). This module removes that cap for the configs
-that need it.
+that need it, and with ``tree_learner=data`` removes the ONE-HOST cap
+too: each rank streams only its own row shard's blocks and the
+per-level histograms meet in a single collective.
 
 Design (SURVEY.md §7.4 hard-part 4, "sharded binning on host, streamed
-epochs"):
+epochs"; §3.4 data-parallel learner for the sharded composition):
 
 - The BINNED matrix (uint8/16, the big object) stays in host RAM; the
   native binner builds it at ~GB/s. Device-resident state is one row
   BLOCK at a time plus the accumulated `[K, F, B, 3]` histograms
   (~11 MB at K=128/F=28/B=256) — HBM use is O(block), not O(n).
-- Trees grow LEVEL-WISE: one streamed pass over all blocks per level
+- Trees grow LEVEL-WISE: one streamed pass over the blocks per level
   computes the histograms of every frontier leaf at once (the same
   multi-leaf one-hot-matmul histogram the resident engine uses), so a
   depth-d tree costs d+1 sweeps of PCIe traffic instead of the
@@ -27,25 +29,54 @@ epochs"):
   divergence from the reference's queue (`serial_tree_learner.cpp`):
   per-sweep cost makes strict best-first (one sweep per leaf)
   ~num_leaves/depth times more expensive.
-- Per-row state (score, leaf id) also lives on host and rides along
-  each sweep; gradients are recomputed on device per block from the
-  streamed score (cheaper than streaming g/h separately).
+- SHARDED (``tree_learner=data``): the row range splits contiguously
+  per rank (mesh device; on a multi-process gang each process streams
+  only its own shard's blocks), every rank accumulates its local
+  `[K, F, B, 3]` level histogram across its blocks exactly like the
+  serial path, and then issues **ONE** ``psum`` (or ``psum_scatter``
+  honoring ``tpu_hist_reduce``) of the ACCUMULATED histogram per tree
+  level through the shared packed-int32 collective wire
+  (learner/collective.py, the same wire the resident data-parallel
+  learner reduces on) — never one collective per block. Split finding
+  sees the global histogram, so every rank grows bit-identical trees;
+  with exact (quantized-integer or small-scale bf16-rounded) histogram
+  sums the trees are also bit-identical to single-shard streaming.
+- Per-row state (score, leaf id) lives device-resident per block;
+  gradients are recomputed on device per block from the streamed
+  score (cheaper than streaming g/h separately).
+- BAGGING / GOSS ride per-block row masks derived on device from a
+  counter-based hash of each row's GLOBAL index — no mask storage, no
+  host traffic, and the same row keeps the same draw no matter how
+  the rows are cut into blocks or shards. GOSS thresholds come from a
+  GLOBAL |g*h| order statistic via a small per-round collective (a
+  65536-bucket float-bit histogram of the metric — the same
+  small-collective pattern the serial learner's guard psum uses), so
+  the kept set is shard-invariant; the selected count can exceed
+  ``top_rate*n`` by the boundary bucket's population (<=0.4% relative
+  metric granularity — a documented divergence from the resident
+  engine's exact top-k).
+- Quantized gradients (``use_quantized_grad``) are supported: integer
+  level histograms make the accumulated sums EXACT at any scale (the
+  bit-identical-across-shards guarantee) and engage the packed int32
+  wire (2/3 payload) on the per-level collective.
 
-Supported configs (v1, all checked at construction): single-output
+Supported configs (all checked at construction): single-output
 objectives (binary, regression family, xentropy) on numerical
-features, serial learner, no row sampling. Everything else —
-multiclass, ranking, categorical splits, GOSS/bagging, DART/RF,
+features, tree_learner serial or data, bagging (incl. pos/neg
+fractions), GOSS, quantized gradients, feature_fraction, extra_trees.
+Everything else — multiclass, ranking, categorical splits, DART/RF,
 linear trees, monotone/CEGB/interaction constraints, EFB, forced
-splits, continuation — stays on the resident engine; `create_boosting`
-only routes here when the data cannot fit (or ``tpu_streaming=true``
-forces it). Split-rule parity (L1/L2, min_data, min_hessian,
-min_gain, max_delta_step, path smoothing, extra-trees, missing
-directions) comes for free: the same `find_best_split` evaluates the
-accumulated histograms.
+splits, continuation, voting-/feature-parallel learners — stays on
+the resident engine; `create_boosting` only routes here when the data
+cannot fit (or ``tpu_streaming=true`` forces it). Split-rule parity
+(L1/L2, min_data, min_hessian, min_gain, max_delta_step, path
+smoothing, extra-trees, missing directions) comes for free: the same
+`find_best_split` evaluates the accumulated histograms.
 """
 from __future__ import annotations
 
 import math
+import time
 from typing import Dict, List
 
 import jax
@@ -61,9 +92,36 @@ from ..ops.split import SplitConfig, find_best_split
 from ..tree import Tree
 from ..utils import log
 
+# |g*h| bucket count for the GOSS threshold histogram: the top 16 bits
+# of the positive-f32 bit pattern (8 exponent + 8 mantissa bits) are
+# monotone in the value, so a bucketed order statistic is exact up to
+# one bucket width (~0.4% relative)
+_GOSS_BUCKETS = 1 << 16
+
 
 def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+def _even_split(n: int, k: int) -> List[int]:
+    """Contiguous near-even row split: first ``n % k`` parts get one
+    extra row (the launcher's shard convention)."""
+    base, rem = divmod(n, k)
+    return [base + (1 if i < rem else 0) for i in range(k)]
+
+
+def _hash_u01(idx_u32, salt_u32):
+    """Counter-based uniform in [0, 1): a pure function of the GLOBAL
+    row index and a per-round salt, so bagging/GOSS/stochastic-rounding
+    draws are identical no matter how rows are cut into blocks or
+    shards (lowne-style 32-bit mix; 24-bit mantissa-exact floats)."""
+    x = idx_u32 + salt_u32 * jnp.uint32(0x9E3779B9)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
 
 def _apply_table(bins_blk, leaf_blk, tbl):
@@ -96,46 +154,10 @@ def _apply_table(bins_blk, leaf_blk, tbl):
     return jnp.where(sel & ~goes_left, new_r, lid).astype(jnp.int16)
 
 
-def _make_sweep(objective, num_bins: int, rows_per_block: int):
-    """Build the jitted per-block level sweep. Only ``bins_blk``
-    streams from host; score/label/weight/leaf are device-resident
-    block slots and the valid-row count rides as one scalar."""
-
-    @jax.jit
-    def sweep(bins_blk, score_blk, label_blk, weight_blk, n_valid,
-              leaf_blk, tbl, frontier):
-        leaf_new = _apply_table(bins_blk, leaf_blk, tbl)
-        cnt = (jnp.arange(leaf_blk.shape[0], dtype=jnp.int32)
-               < n_valid).astype(jnp.float32)
-        g, h = objective.get_gradients(score_blk, label_blk, weight_blk)
-        g = g.reshape(-1).astype(jnp.float32)
-        h = h.reshape(-1).astype(jnp.float32)
-        vals = jnp.stack([g * cnt, h * cnt, cnt], axis=1)
-        hist = multi_leaf_histogram_xla(
-            bins_blk, vals, leaf_new.astype(jnp.int32), frontier,
-            num_bins=num_bins, rows_per_block=rows_per_block)
-        return leaf_new, hist
-
-    return sweep
-
-
-def _make_final(objective, lr: float):
-    """Jitted final sweep: apply the last split table and add leaf
-    outputs to the device-resident score."""
-
-    @jax.jit
-    def final(bins_blk, score_blk, leaf_blk, tbl, leaf_out):
-        leaf_new = _apply_table(bins_blk, leaf_blk, tbl)
-        score_new = score_blk + lr * leaf_out[
-            jnp.clip(leaf_new.astype(jnp.int32), 0,
-                     leaf_out.shape[0] - 1)]
-        return leaf_new, score_new
-
-    return final
-
-
 class StreamingGBDT:
-    """Boosting engine for datasets whose binned matrix exceeds HBM.
+    """Boosting engine for datasets whose binned matrix exceeds HBM —
+    single-shard, or data-parallel over a mesh when the per-rank shard
+    would still exceed HBM (the Criteo-1TB-class composition).
 
     Quacks like `gbdt.GBDT` for the surfaces the Booster/engine.train
     loop and the model writer touch; everything per-row lives on host.
@@ -143,9 +165,10 @@ class StreamingGBDT:
 
     _UNSUPPORTED_MSG = (
         "tpu_streaming (out-of-core) supports single-output objectives "
-        "on numerical features with tree_learner=serial and no row "
-        "sampling; {what} requires the resident engine — reduce the "
-        "dataset, raise the device budget, or drop the option")
+        "on numerical features with tree_learner=serial or data "
+        "(bagging, GOSS and quantized gradients included); {what} "
+        "requires the resident engine — reduce the dataset, raise the "
+        "device budget, or drop the option")
 
     def __init__(self, config: Config, train_set: Dataset,
                  fobj=None, mesh=None, init_forest=None):
@@ -159,13 +182,14 @@ class StreamingGBDT:
 
         _no(fobj is not None, "a custom objective function")
         _no(init_forest is not None, "training continuation/init_model")
-        _no(mesh is not None or config.tree_learner != "serial",
-            f"tree_learner={config.tree_learner}")
+        _no(config.tree_learner not in ("serial", "data"),
+            f"tree_learner={config.tree_learner} (streamed training "
+            f"shards ROWS; voting/feature-parallel search needs the "
+            f"resident column layout)")
+        _no(mesh is not None and config.tree_learner == "serial",
+            "an explicit mesh with tree_learner=serial")
         _no(config.num_tree_per_iteration > 1, "multiclass")
         _no(config.boosting in ("dart", "rf"), f"boosting={config.boosting}")
-        _no(str(config.data_sample_strategy) == "goss", "GOSS")
-        _no(config.bagging_fraction < 1.0 or config.bagging_freq > 0,
-            "bagging")
         _no(bool(config.linear_tree), "linear_tree")
         _no(bool(config.monotone_constraints), "monotone constraints")
         _no(bool(config.interaction_constraints),
@@ -176,12 +200,12 @@ class StreamingGBDT:
         _no(bool(config.forcedsplits_filename), "forced splits")
         if getattr(config, "_quantize_auto", False):
             # auto-quantize (tpu_auto_quantize) targets the resident
-            # int8 histogram kernels; out-of-core sweeps are PCIe-bound
-            # so discretization buys nothing — quietly demote
+            # int8 histogram kernels; an un-asked-for discretization
+            # would change streamed numerics — quietly demote. An
+            # EXPLICIT use_quantized_grad is honored: integer level
+            # histograms are what make sharded streaming bit-exact and
+            # engage the packed collective wire.
             config.use_quantized_grad = False
-        _no(bool(config.use_quantized_grad),
-            "use_quantized_grad (stream blocks are already int8; "
-            "gradient discretization adds nothing out-of-core)")
         is_cat = [ds.bin_mappers[f].bin_type == "categorical"
                   for f in ds.used_features]
         _no(any(is_cat), "categorical features")
@@ -201,9 +225,9 @@ class StreamingGBDT:
 
         self.binned = ds.binned                     # host [n, F] uint
         if ds.device_ingested() is not None:
-            # the streaming engine scans host blocks only — release a
-            # device-resident ingest copy (possible when a standalone
-            # construct picked device ingest before a forced
+            # streamed blocks are uploaded one at a time per rank —
+            # release a device-resident ingest copy (possible when a
+            # standalone construct picked device ingest before a forced
             # tpu_streaming run) instead of leaving it orphaned in HBM
             ds._ingest = None
         self.n = int(ds.num_data)
@@ -220,18 +244,25 @@ class StreamingGBDT:
         self._num_bin_np = num_bin.astype(np.int32)
         self._has_nan_np = has_nan
 
-        # block size: bins block ~256 MB by default (PCIe-friendly,
-        # far under any HBM), rounded to a lane multiple
-        blk = int(config.tpu_stream_block_rows)
-        if blk <= 0:
-            blk = max(1 << 16, (256 << 20) // max(F, 1))
-        blk = min(blk, max(self.n, 8))
-        # the hist kernel's internal row chunk must divide the block;
-        # blocks >= 16 Ki rows round up to a 16 Ki multiple (the last
-        # block pads), smaller ones use the block itself as the chunk
-        self.block_rows = (_ceil_to(blk, 1 << 14) if blk >= (1 << 14)
-                           else _ceil_to(blk, 8))
-        self.n_blocks = max(1, math.ceil(self.n / self.block_rows))
+        # ---- mesh / rank layout (tree_learner=data) ------------------
+        self.mesh = None
+        self._axis = ""
+        R = 1
+        if config.tree_learner == "data":
+            if mesh is not None:
+                self.mesh = mesh
+            else:
+                from ..parallel.mesh import create_data_mesh
+                nd = (int(config.tpu_mesh_shape)
+                      if str(config.tpu_mesh_shape).strip() else None)
+                self.mesh = create_data_mesh(nd)
+            R = int(self.mesh.devices.size)
+            if R == 1:
+                self.mesh = None    # one shard: the serial path IS it
+            else:
+                self._axis = self.mesh.axis_names[0]
+        self.R = R
+        self._build_ranks()
 
         if int(config.num_leaves) > 32767:
             log.fatal("tpu_streaming caps num_leaves at 32767 (int16 "
@@ -256,81 +287,584 @@ class StreamingGBDT:
             extra_trees=config.extra_trees,
         )
         self.lr = float(config.learning_rate)
-        self._hist_rows_per_block = min(self.block_rows, 1 << 14)
-        self._sweep = _make_sweep(self.objective, self.B,
-                                  self._hist_rows_per_block)
-        self._final = _make_final(self.objective, self.lr)
-        self._find = self._make_find()
         self._rng = np.random.default_rng(int(config.seed) & 0x7FFFFFFF)
         self._ff = float(config.feature_fraction)
 
-        # device-resident per-row state, one slot per block: score f32,
-        # leaf int16, label f32, weight f32 (if any) — ~10 bytes/row
-        # total, so state for a 32 GiB (1.1e9-row) bin matrix fits v5e
-        # HBM while the 28x-larger bins stream. Through the tunneled
-        # chip this is also the latency fix: per sweep the ONLY host
-        # traffic is the bins block up and one packed [K,13] pull down
-        # (the D2H path measures ~60 MB/s here — round-tripping leaf
-        # ids per sweep was the first version's wall).
+        # ---- row sampling + quantization statics ---------------------
+        c = config
+        self._use_goss = str(c.data_sample_strategy) == "goss"
+        self._use_bag = (not self._use_goss and c.bagging_freq > 0
+                         and (c.bagging_fraction < 1.0
+                              or c.pos_bagging_fraction < 1.0
+                              or c.neg_bagging_fraction < 1.0))
+        self._bag_posneg = self._use_bag and (
+            c.pos_bagging_fraction < 1.0 or c.neg_bagging_fraction < 1.0)
+        self._top_rate = float(c.top_rate)
+        self._other_rate = float(c.other_rate)
+        self._goss_amp = ((1.0 - self._top_rate)
+                          / max(self._other_rate, 1e-12))
+        self._use_quant = bool(c.use_quantized_grad)
+        self._use_sr = self._use_quant and bool(c.stochastic_rounding)
+        qbins = max(2, int(c.num_grad_quant_bins))
+        self._glevels = max(qbins // 2, 1)
+        self._hlevels = max(qbins - 1, 1)
+        self._track_stats = self._use_goss or self._use_quant
+        self._seed_u32 = np.uint32(int(c.seed) & 0xFFFFFFFF)
+        self._bag_seed_u32 = np.uint32(int(c.bagging_seed) & 0xFFFFFFFF)
+        self._pending_stats = None
+        if (self._use_bag or self._use_goss or self._use_sr) \
+                and self.n_global > 0x7FFFFFFF:
+            log.fatal("tpu_streaming row sampling hashes int32 global "
+                      "row indices; > 2^31-1 rows need sampling off")
+        # collective wire mode (mirrors the resident data learner):
+        # psum_scatter feature ownership when tpu_hist_reduce=scatter
+        # and the width divides; packed int32 wire under quantization
+        self._scatter = (str(c.tpu_hist_reduce) == "scatter"
+                         and self.R > 1 and F > 0 and F % self.R == 0)
+        self._packed_wire = (self._use_quant and self.R > 1
+                             and bool(c.tpu_hist_packed_wire))
+        # host-side comm/stream counters — always on (plain ints), the
+        # obs registry mirrors them when metrics are enabled
+        self.comm_stats = {"allreduce_calls": 0, "allreduce_bytes": 0,
+                           "blocks_scanned": 0, "levels": 0}
+
+        self._hist_rows_per_block = min(self.block_rows, 1 << 14)
+        self._sweep = self._make_sweep()
+        self._final = self._make_final()
+        self._stats_fn = (jax.jit(self._stats_core())
+                          if self._track_stats else None)
+        self._find = self._make_find()
+        self._find_sharded = (self._make_find_sharded()
+                              if self.R > 1 else None)
+        self._stats_reduce = (self._make_stats_reduce()
+                              if self._track_stats and self.R > 1
+                              else None)
+
+        # device-resident per-row state, one slot per (rank, block):
+        # score f32, leaf int16, label f32, weight f32 (if any) — ~10
+        # bytes/row total, so state for a 32 GiB (1.1e9-row) bin matrix
+        # fits v5e HBM while the 28x-larger bins stream. Through the
+        # tunneled chip this is also the latency fix: per sweep the
+        # ONLY host traffic is the bins block up and one packed [K,13]
+        # pull down (the D2H path measures ~60 MB/s here — round-
+        # tripping leaf ids per sweep was the first version's wall).
         init = np.float32(self.init_scores[0])
-        self._score_dev = []
-        self._leaf_dev = []
-        self._label_dev = []
-        self._weight_dev = []
-        zeros_leaf = jnp.zeros(self.block_rows, jnp.int16)
-        ones_w = (jnp.ones(self.block_rows, jnp.float32)
-                  if self.weight is None else None)  # shared constant
-        for b, lo, hi in self._blocks():
-            self._score_dev.append(
-                jnp.full(self.block_rows, init, jnp.float32))
-            self._leaf_dev.append(zeros_leaf)
-            self._label_dev.append(
-                jnp.asarray(self._pad_block(self.label, lo, hi)))
-            self._weight_dev.append(
-                jnp.asarray(self._pad_block(self.weight, lo, hi))
-                if self.weight is not None else ones_w)
-        self._zeros_leaf = zeros_leaf
+        self._score_dev: List[list] = []
+        self._leaf_dev: List[list] = []
+        self._label_dev: List[list] = []
+        self._weight_dev: List[list] = []
+        self._zeros_leaf: List[jax.Array] = []
+        for ri, rk in enumerate(self._ranks):
+            dev = rk["dev"]
+            zeros_leaf = self._put(
+                np.zeros(self.block_rows, np.int16), dev)
+            ones_w = (self._put(np.ones(self.block_rows, np.float32),
+                                dev)
+                      if self.weight is None else None)
+            self._zeros_leaf.append(zeros_leaf)
+            sc, lf, lb, wt = [], [], [], []
+            for b, lo, hi in self._rank_blocks(ri):
+                sc.append(self._put(
+                    np.full(self.block_rows, init, np.float32), dev))
+                lf.append(zeros_leaf)
+                lb.append(self._put(
+                    self._pad_block(self.label, lo, hi), dev))
+                wt.append(self._put(
+                    self._pad_block(self.weight, lo, hi), dev)
+                    if self.weight is not None else ones_w)
+            self._score_dev.append(sc)
+            self._leaf_dev.append(lf)
+            self._label_dev.append(lb)
+            self._weight_dev.append(wt)
         # the f32 copies were only needed for the device upload; at
         # 1e9+ rows they are multiple GiB of host RAM. (The Dataset's
         # own float64 metadata.label stays — it backs the public
         # get_label() API and is owned by the Dataset, not the engine.)
         self.label = self.weight = None
+        n_blocks_local = sum(rk["n_blocks"] for rk in self._ranks)
+        self.n_blocks = n_blocks_local
         log.info(
             f"streaming engine: {self.n} rows x {F} features binned on "
             f"host ({self.binned.nbytes / 2**30:.2f} GiB), "
-            f"{self.n_blocks} blocks of {self.block_rows} rows")
+            f"{n_blocks_local} local blocks of {self.block_rows} rows"
+            + (f", shard {[r['pos'] for r in self._ranks]} of "
+               f"{self.R} ({self.n_global} global rows; one "
+               f"{'psum_scatter' if self._scatter else 'psum'} per "
+               f"level{', packed int32 wire' if self._packed_wire else ''})"
+               if self.R > 1 else ""))
+
+    # ------------------------------------------------------ rank layout
+    def _put(self, arr, dev):
+        """Device placement: committed to the rank's mesh device when
+        sharded, the default device otherwise (matching the serial
+        streaming path's uncommitted placement)."""
+        if dev is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, dev)
+
+    def _build_ranks(self):
+        """Split this process's rows over its local mesh devices and
+        learn every rank's GLOBAL row offset (the seed of the
+        shard-invariant row hash). Single process: all ranks are local;
+        a multi-process gang contributes its own shard (the launcher's
+        ``data_fn`` row partition) and gathers the per-rank counts."""
+        cfg = self.config
+        R = self.R
+        if R == 1:
+            self._ranks = [{"pos": 0, "dev": None, "lo": 0,
+                            "hi": self.n, "goff": 0}]
+            self.n_global = self.n
+            counts_all = np.asarray([self.n], np.int64)
+        else:
+            from ..parallel.mesh import local_mesh_positions
+            flat = list(self.mesh.devices.flat)
+            nproc = jax.process_count()
+            if nproc > 1:
+                my_pos, _ = local_mesh_positions(self.mesh)
+                if not my_pos:
+                    # a gang member outside the (possibly capped) mesh
+                    # would silently drop its rows AND deadlock the
+                    # in-mesh ranks' collectives — fatal like the
+                    # zero-rows guard below
+                    log.fatal(
+                        f"streamed sharded training: process "
+                        f"{jax.process_index()} owns no device of the "
+                        f"{R}-shard mesh (tpu_mesh_shape smaller than "
+                        f"the gang?) — its rows would be dropped; "
+                        f"match the mesh size to the process count")
+                sizes = _even_split(self.n, len(my_pos))
+                counts = np.zeros(R, np.int64)
+                for i, p in enumerate(my_pos):
+                    counts[p] = sizes[i]
+                from jax.experimental import multihost_utils
+                g = np.asarray(
+                    multihost_utils.process_allgather(counts)).reshape(
+                        nproc, R)
+                counts_all = g.sum(axis=0).astype(np.int64)
+            else:
+                my_pos = list(range(R))
+                sizes = _even_split(self.n, R)
+                counts_all = np.asarray(sizes, np.int64)
+            goffs = np.concatenate(
+                [[0], np.cumsum(counts_all)[:-1]]).astype(np.int64)
+            self.n_global = int(counts_all.sum())
+            lo = 0
+            self._ranks = []
+            for i, p in enumerate(my_pos):
+                rows = int(counts_all[p]) if nproc > 1 else sizes[i]
+                self._ranks.append({"pos": p, "dev": flat[p], "lo": lo,
+                                    "hi": lo + rows,
+                                    "goff": int(goffs[p])})
+                lo += rows
+        bad = ([int(p) for p in np.nonzero(counts_all <= 0)[0]]
+               if R > 1 else [])
+        if bad:
+            # mirrors _cli_file_shard's early fatal: a rank that would
+            # stream zero blocks deadlocks the per-level collective
+            log.fatal(
+                f"streamed sharded training would hand rank(s) "
+                f"{bad[:8]} zero rows ({self.n_global} global rows "
+                f"over {self.R} shards) — every rank must stream at "
+                f"least one block; lower tpu_mesh_shape / the process "
+                f"count, or feed more rows")
+
+        # block size: bins block ~256 MB by default (PCIe-friendly, far
+        # under any HBM), rounded to a lane multiple; per-RANK row
+        # ranges cut into blocks of this size (the last block pads)
+        rank_max = int(counts_all.max())
+        blk = int(cfg.tpu_stream_block_rows)
+        explicit = blk > 0
+        if blk <= 0:
+            blk = max(1 << 16, (256 << 20) // max(self.num_features, 1))
+        blk = min(blk, max(rank_max, 8))
+        # the hist kernel's internal row chunk must divide the block;
+        # blocks >= 16 Ki rows round up to a 16 Ki multiple (the last
+        # block pads), smaller ones use the block itself as the chunk
+        self.block_rows = (_ceil_to(blk, 1 << 14) if blk >= (1 << 14)
+                           else _ceil_to(blk, 8))
+        if explicit and self.block_rows != blk:
+            # warn only on a real ROUNDING of the requested size (the
+            # histogram kernel's row chunk must divide the block) —
+            # a value merely clamped to the per-rank row count is a
+            # normal one-block configuration, not a mismatch
+            log.warning(
+                f"tpu_stream_block_rows={cfg.tpu_stream_block_rows} "
+                f"does not divide cleanly against the per-rank row "
+                f"range / histogram row chunk; rounded to "
+                f"{self.block_rows}")
+        for rk in self._ranks:
+            rk["n_blocks"] = max(
+                1, math.ceil((rk["hi"] - rk["lo"]) / self.block_rows))
+
+    def _rank_blocks(self, ri: int):
+        rk = self._ranks[ri]
+        for b in range(rk["n_blocks"]):
+            lo = rk["lo"] + b * self.block_rows
+            hi = min(rk["hi"], lo + self.block_rows)
+            yield b, lo, hi
+
+    # --------------------------------------------------- jitted pieces
+    def _make_sweep(self):
+        """Build the jitted per-block level sweep. Only ``bins_blk``
+        streams from host; score/label/weight/leaf are device-resident
+        block slots and the valid-row count rides as one scalar.
+        Bagging/GOSS masks are derived in-sweep from the block's GLOBAL
+        row offset (``off``) + the per-round sampling scalars
+        (``sampf``/``sampi``), so they cost zero host traffic and are
+        invariant to the block/shard cut."""
+        objective = self.objective
+        num_bins = self.B
+        rpb = self._hist_rows_per_block
+        use_bag, posneg = self._use_bag, self._bag_posneg
+        use_goss, amp = self._use_goss, self._goss_amp
+        use_quant, use_sr = self._use_quant, self._use_sr
+        c = self.config
+        bag_frac = float(c.bagging_fraction)
+        pos_frac = float(c.pos_bagging_fraction)
+        neg_frac = float(c.neg_bagging_fraction)
+
+        def masks(g, h, label_blk, cnt, idx_u32, sampf, sampi):
+            if use_goss:
+                metric = jnp.abs(g * h) * cnt
+                live = cnt > 0
+                is_top = (metric >= sampf[0]) & live
+                u = _hash_u01(idx_u32, sampi[1])
+                picked = live & ~is_top & (u < sampf[1])
+                mask_gh = (is_top.astype(jnp.float32)
+                           + picked.astype(jnp.float32)
+                           * jnp.float32(amp))
+                mask_cnt = (is_top | picked).astype(jnp.float32)
+                return mask_gh, mask_cnt
+            if use_bag:
+                u = _hash_u01(idx_u32, sampi[0])
+                if posneg:
+                    keep = jnp.where(label_blk > 0, u < pos_frac,
+                                     u < neg_frac)
+                else:
+                    keep = u < bag_frac
+                m = cnt * keep.astype(jnp.float32)
+                return m, m
+            return cnt, cnt
+
+        @jax.jit
+        def sweep(bins_blk, score_blk, label_blk, weight_blk, n_valid,
+                  leaf_blk, tbl, frontier, off, sampf, sampi):
+            leaf_new = _apply_table(bins_blk, leaf_blk, tbl)
+            ar = jnp.arange(leaf_blk.shape[0], dtype=jnp.int32)
+            cnt = (ar < n_valid).astype(jnp.float32)
+            idx_u32 = (off + ar).astype(jnp.uint32)
+            g, h = objective.get_gradients(score_blk, label_blk,
+                                           weight_blk)
+            g = g.reshape(-1).astype(jnp.float32)
+            h = h.reshape(-1).astype(jnp.float32)
+            mask_gh, mask_cnt = masks(g, h, label_blk, cnt, idx_u32,
+                                      sampf, sampi)
+            gm = g * mask_gh
+            hm = h * mask_gh
+            if use_quant:
+                # deterministic (or hash-seeded stochastic) rounding to
+                # integer levels: exact in the bf16 histogram matmul,
+                # exact under any summation order, and int16-packable
+                # on the collective wire
+                ng = ((_hash_u01(idx_u32, sampi[2]) - 0.5)
+                      if use_sr else 0.0)
+                nh = ((_hash_u01(idx_u32, sampi[3]) - 0.5)
+                      if use_sr else 0.0)
+                gq = jnp.round(gm / sampf[2] + ng)
+                hq = jnp.round(hm / sampf[3] + nh)
+                live = mask_cnt > 0
+                gq = jnp.where(live, gq, 0.0)
+                hq = jnp.where(live, hq, 0.0)
+                vals = jnp.stack([gq, hq, mask_cnt], axis=1)
+            else:
+                vals = jnp.stack([gm, hm, mask_cnt], axis=1)
+            hist = multi_leaf_histogram_xla(
+                bins_blk, vals, leaf_new.astype(jnp.int32), frontier,
+                num_bins=num_bins, rows_per_block=rpb)
+            return leaf_new, hist
+
+        return sweep
+
+    def _stats_core(self):
+        """Per-block round statistics from device-resident state ONLY
+        (no bins traffic): unmasked |g|/h maxima (quantization scales)
+        and, under GOSS, the 65536-bucket |g*h| float-bit histogram the
+        global threshold is read from."""
+        objective = self.objective
+        use_goss = self._use_goss
+
+        def core(score_blk, label_blk, weight_blk, n_valid):
+            ar = jnp.arange(score_blk.shape[0], dtype=jnp.int32)
+            cnt = (ar < n_valid).astype(jnp.float32)
+            g, h = objective.get_gradients(score_blk, label_blk,
+                                           weight_blk)
+            g = g.reshape(-1).astype(jnp.float32)
+            h = h.reshape(-1).astype(jnp.float32)
+            ga = jnp.abs(g) * cnt
+            hv = h * cnt
+            maxs = jnp.stack([jnp.max(ga), jnp.max(hv)])
+            if use_goss:
+                metric = jnp.abs(g * h) * cnt
+                b = (jax.lax.bitcast_convert_type(metric, jnp.int32)
+                     >> 15)
+                counts = jnp.zeros(_GOSS_BUCKETS, jnp.int32).at[b].add(
+                    (cnt > 0).astype(jnp.int32))
+            else:
+                counts = jnp.zeros(1, jnp.int32)
+            return maxs, counts
+
+        return core
+
+    def _make_final(self):
+        """Jitted final sweep: apply the last split table, add leaf
+        outputs to the device-resident score, and (under GOSS/quant)
+        fold next round's statistics out of the NEW score — the stats
+        prepass rides the sweep that was already touching every
+        block."""
+        lr = self.lr
+        track = self._track_stats
+        core = self._stats_core() if track else None
+
+        @jax.jit
+        def final(bins_blk, score_blk, label_blk, weight_blk, n_valid,
+                  leaf_blk, tbl, leaf_out):
+            leaf_new = _apply_table(bins_blk, leaf_blk, tbl)
+            score_new = score_blk + lr * leaf_out[
+                jnp.clip(leaf_new.astype(jnp.int32), 0,
+                         leaf_out.shape[0] - 1)]
+            if track:
+                maxs, counts = core(score_new, label_blk, weight_blk,
+                                    n_valid)
+            else:
+                maxs = jnp.zeros(2, jnp.float32)
+                counts = jnp.zeros(1, jnp.int32)
+            return leaf_new, score_new, maxs, counts
+
+        return final
+
+    def _pack13(self, r, p):
+        return jnp.concatenate([
+            jnp.stack([r["gain"], r["feature"].astype(jnp.float32),
+                       r["threshold_bin"].astype(jnp.float32),
+                       r["default_left"].astype(jnp.float32)]),
+            r["left_sums"].astype(jnp.float32),
+            r["right_sums"].astype(jnp.float32),
+            p.astype(jnp.float32)])
 
     def _make_find(self):
-        """Jitted per-level split search over the frontier. Everything
-        the host loop needs comes back PACKED into one [K, 13] f32
-        array (gain, feature, threshold_bin, default_left,
-        left_sums[3], right_sums[3], parent_sums[3]) — through the
-        tunneled chip every separate device->host pull pays ~30-100 ms
-        of latency, and the unpacked dict was ~20 pulls per level.
-        ``allowed`` is a TRACED argument (same [F] bool shape every
-        call) so per-tree feature_fraction masks never recompile.
-        With ``extra_trees``, per-(leaf, feature) uniforms ride a
-        fourth traced argument (drawn host-side from ``self._rng`` per
-        level — mirroring learner/serial.py's per-round draws), so the
+        """Jitted per-level split search over the frontier (single-
+        shard path). Everything the host loop needs comes back PACKED
+        into one [K, 13] f32 array (gain, feature, threshold_bin,
+        default_left, left_sums[3], right_sums[3], parent_sums[3]) —
+        through the tunneled chip every separate device->host pull pays
+        ~30-100 ms of latency, and the unpacked dict was ~20 pulls per
+        level. ``allowed`` is a TRACED argument (same [F] bool shape
+        every call) so per-tree feature_fraction masks never recompile;
+        ``scale`` rescales quantized integer level sums to real units
+        (ones — an exact multiply — when quantization is off). With
+        ``extra_trees``, per-(leaf, feature) uniforms ride a traced
+        argument (drawn host-side from ``self._rng`` per level —
+        mirroring learner/serial.py's per-round draws), so the
         one-random-threshold-per-node semantics actually bind instead
         of silently degrading to plain GBDT (find_best_split skips the
         extra_trees filter when extra_u is None)."""
         use_extra = bool(self._scfg.extra_trees)
+        nb, hn = self.feat_num_bin, self.feat_has_nan
+        scfg = self._scfg
+        pack = self._pack13
 
         def one(h, p, allowed, eu):
-            r = find_best_split(h, p, self.feat_num_bin,
-                                self.feat_has_nan, allowed, self._scfg,
-                                extra_u=eu)
-            return jnp.concatenate([
-                jnp.stack([r["gain"], r["feature"].astype(jnp.float32),
-                           r["threshold_bin"].astype(jnp.float32),
-                           r["default_left"].astype(jnp.float32)]),
-                r["left_sums"].astype(jnp.float32),
-                r["right_sums"].astype(jnp.float32),
-                p.astype(jnp.float32)])
+            r = find_best_split(h, p, nb, hn, allowed, scfg,
+                                extra_u=eu if use_extra else None)
+            return pack(r, p)
 
-        return jax.jit(jax.vmap(
-            one, in_axes=(0, 0, None, 0 if use_extra else None)))
+        @jax.jit
+        def find(hist, allowed, eu, scale):
+            # leaf totals from the RAW histogram (integer-exact under
+            # quantization, so identical on every shard/feature), then
+            # rescale totals and histogram to real units together
+            parent = jnp.sum(hist[:, 0, :, :], axis=1) * scale
+            h = hist * scale
+            return jax.vmap(one, in_axes=(0, 0, None,
+                                          0 if use_extra else None))(
+                h, parent, allowed, eu)
+
+        return find
+
+    def _make_find_sharded(self):
+        """The sharded per-level program: ONE histogram collective
+        (psum, or psum_scatter + best-split election under
+        tpu_hist_reduce=scatter) of the accumulated [K, F, B, 3] level
+        histogram through the shared packed-int32 wire
+        (learner/collective.py), then the same packed [K, 13] split
+        search — replicated output, identical on every rank."""
+        from ..learner.collective import hist_allreduce
+        from ..parallel.mesh import P, shard_map
+        axis = self._axis
+        R = self.R
+        F = self.num_features
+        scatter = self._scatter
+        F_s = F // R if scatter else F
+        packed_wire = self._packed_wire
+        use_extra = bool(self._scfg.extra_trees)
+        nb_full, hn_full = self.feat_num_bin, self.feat_has_nan
+        scfg = self._scfg
+        pack = self._pack13
+
+        def impl(hist_blk, allowed, eu, scale):
+            h = hist_allreduce(hist_blk[0], axis, scatter=scatter,
+                               scatter_dim=1, packed=packed_wire)
+            # leaf totals straight from the RAW reduced histogram: any
+            # one owned feature's bins partition the leaf's rows, and
+            # summing BEFORE the channel rescale keeps the totals
+            # integer-exact under quantization — every shard derives
+            # the identical [K, 3] no matter which feature it owns
+            # (scaled sums differ in ULPs between features, which
+            # would leak shard-dependent leaf values through the
+            # elected record's parent slot)
+            parent = jnp.sum(h[:, 0, :, :], axis=1) * scale
+            h = h * scale
+            if scatter:
+                off = (jax.lax.axis_index(axis) * F_s).astype(jnp.int32)
+                nb = jax.lax.dynamic_slice_in_dim(nb_full, off, F_s)
+                hn = jax.lax.dynamic_slice_in_dim(hn_full, off, F_s)
+                al = jax.lax.dynamic_slice_in_dim(allowed, off, F_s)
+                eu_s = (jax.lax.dynamic_slice_in_dim(eu, off, F_s,
+                                                     axis=1)
+                        if use_extra else eu)
+            else:
+                off = jnp.zeros((), jnp.int32)
+                nb, hn, al, eu_s = nb_full, hn_full, allowed, eu
+
+            def one(hk, pk, euk):
+                r = find_best_split(hk, pk, nb, hn, al, scfg,
+                                    extra_u=euk if use_extra else None)
+                r = dict(r)
+                r["feature"] = r["feature"] + off
+                return pack(r, pk)
+
+            packed13 = jax.vmap(one, in_axes=(0, 0,
+                                              0 if use_extra else None))(
+                h, parent, eu_s)
+            if scatter:
+                # SyncUpGlobalBestSplit across feature owners: a small
+                # [R, K, 13] all_gather + per-leaf max-gain election
+                allp = jax.lax.all_gather(packed13, axis)
+                win = jnp.argmax(allp[..., 0], axis=0)
+                packed13 = jnp.take_along_axis(
+                    allp, win[None, :, None].astype(jnp.int32),
+                    axis=0)[0]
+            return packed13
+
+        return jax.jit(shard_map(
+            impl, mesh=self.mesh,
+            in_specs=(P(axis), P(), P(), P()),
+            out_specs=P(), check_vma=False))
+
+    def _make_stats_reduce(self):
+        """Small per-round collective: pmax of the |g|/h maxima + psum
+        of the GOSS bucket histogram (the 'tiny guard psum' pattern the
+        serial packed wire uses)."""
+        from ..parallel.mesh import P, shard_map
+        axis = self._axis
+
+        def impl(maxs, counts):
+            return (jax.lax.pmax(maxs[0], axis),
+                    jax.lax.psum(counts[0], axis))
+
+        return jax.jit(shard_map(
+            impl, mesh=self.mesh, in_specs=(P(axis), P(axis)),
+            out_specs=(P(), P()), check_vma=False))
+
+    def _global_of(self, parts):
+        """Assemble per-rank device arrays (each ``[1, ...]`` on its
+        mesh device) into one mesh-sharded global array — zero-copy;
+        the collective program reads its shard in place."""
+        from jax.sharding import NamedSharding
+        from ..parallel.mesh import P
+        shape = (self.R,) + tuple(parts[0].shape[1:])
+        return jax.make_array_from_single_device_arrays(
+            shape, NamedSharding(self.mesh, P(self._axis)), parts)
+
+    # ---------------------------------------------- per-round sampling
+    @staticmethod
+    def _salt32(seed_u32, tag: int, k: int) -> int:
+        x = (int(seed_u32) ^ ((tag * 0x9E3779B9) & 0xFFFFFFFF)
+             ^ ((int(k) * 0x85EBCA6B) & 0xFFFFFFFF)) & 0xFFFFFFFF
+        return x
+
+    def _collect_stats(self):
+        """Reduce the pending per-rank round statistics (folded out of
+        the previous final sweep, or computed by a standalone device-
+        only prepass on round 0) into global (gmax, hmax, buckets)."""
+        if self._pending_stats is None:
+            pend = []
+            for ri in range(len(self._ranks)):
+                maxs = counts = None
+                for b, lo, hi in self._rank_blocks(ri):
+                    m, c = self._stats_fn(
+                        self._score_dev[ri][b], self._label_dev[ri][b],
+                        self._weight_dev[ri][b], np.int32(hi - lo))
+                    maxs = m if maxs is None else jnp.maximum(maxs, m)
+                    counts = c if counts is None else counts + c
+                pend.append((maxs, counts))
+            self._pending_stats = pend
+        pend = self._pending_stats
+        self._pending_stats = None     # consumed; the final sweep refills
+        if self.R == 1:
+            maxs = np.asarray(pend[0][0], np.float64)
+            counts = np.asarray(pend[0][1], np.int64)
+        else:
+            m, c = self._stats_reduce(
+                self._global_of([p[0][None] for p in pend]),
+                self._global_of([p[1][None] for p in pend]))
+            maxs = np.asarray(m, np.float64)
+            counts = np.asarray(c, np.int64)
+        return float(maxs[0]), float(maxs[1]), counts
+
+    def _round_sampling(self):
+        """Host-side per-round sampling/quantization scalars:
+        ``sampf`` = [goss_thr, goss_p_pick, scale_g, scale_h] (f32),
+        ``sampi`` = [bag_salt, goss_salt, sr_g_salt, sr_h_salt] (u32),
+        plus the [3] channel rescale for split finding. Derived from
+        GLOBAL statistics, so every rank computes identical values."""
+        it = self.iter_
+        sampf = np.zeros(4, np.float32)
+        sampi = np.zeros(4, np.uint32)
+        if self._use_bag:
+            k = it // max(int(self.config.bagging_freq), 1)
+            sampi[0] = self._salt32(self._bag_seed_u32, 0xBA66, k)
+        if self._track_stats:
+            gmax, hmax, counts = self._collect_stats()
+            if self._use_goss:
+                sampi[1] = self._salt32(self._seed_u32, 0x6055, it)
+                total = int(counts.sum())
+                k_top = max(1, int(total * self._top_rate))
+                rev = np.cumsum(counts[::-1])
+                j = min(int(np.searchsorted(rev, k_top)),
+                        _GOSS_BUCKETS - 1)
+                thr_bucket = (_GOSS_BUCKETS - 1) - j
+                count_top = int(rev[j])
+                sampf[0] = np.array([thr_bucket << 15],
+                                    np.uint32).view(np.float32)[0]
+                n_rest = max(total - count_top, 0)
+                k_rand = int(total * self._other_rate)
+                sampf[1] = (min(1.0, k_rand / n_rest)
+                            if n_rest > 0 else 0.0)
+            if self._use_quant:
+                # unmasked maxima bound the masked values; GOSS
+                # amplification widens the bound by (1-a)/b so levels
+                # stay within +-glevels (a coarser grid than the
+                # resident engine's masked max — documented)
+                ampf = self._goss_amp if self._use_goss else 1.0
+                sampf[2] = max(gmax * ampf, 1e-30) / self._glevels
+                sampf[3] = max(hmax * ampf, 1e-30) / self._hlevels
+                if self._use_sr:
+                    sampi[2] = self._salt32(self._seed_u32, 0x56A1, it)
+                    sampi[3] = self._salt32(self._seed_u32, 0x56A2, it)
+        scale = (np.asarray([sampf[2], sampf[3], 1.0], np.float32)
+                 if self._use_quant else np.ones(3, np.float32))
+        return sampf, sampi, scale
 
     def _leaf_out_np(self, g: float, h: float) -> float:
         """calc_leaf_output (ops/split.py) in host numpy — leaf outputs
@@ -359,7 +893,8 @@ class StreamingGBDT:
     def add_valid(self, data, name):
         """Valid sets evaluate via the host model over the RAW valid
         features (the streaming engine never bins or uploads them —
-        a valid set large enough to matter should be subsampled)."""
+        a valid set large enough to matter should be subsampled).
+        Multi-process gangs evaluate each process's LOCAL valid shard."""
         raw = getattr(data, "data", None)
         if raw is None or isinstance(raw, str):
             log.fatal(self._UNSUPPORTED_MSG.format(
@@ -392,13 +927,18 @@ class StreamingGBDT:
 
         Training eval (which=-1) pulls the full device-resident score
         each call — 4 bytes/row of D2H; at 1e9-row scale through a
-        slow pull path enable it sparingly (metric_freq)."""
+        slow pull path enable it sparingly (metric_freq). On a
+        multi-process gang metrics cover this process's LOCAL rows,
+        and rank 0's values are broadcast so early stopping cannot
+        take rank-divergent decisions (a rank unwinding early would
+        deadlock the others in the per-level collective)."""
         from ..metric import eval_metric_rows
         if which < 0:
             name = "training"
             raw = np.concatenate(
-                [np.asarray(self._score_dev[b])[:hi - lo]
-                 for b, lo, hi in self._blocks()])
+                [np.asarray(self._score_dev[ri][b])[:hi - lo]
+                 for ri in range(len(self._ranks))
+                 for b, lo, hi in self._rank_blocks(ri)])
             md = self.train_set.metadata
             label, weight, qb = md.label, md.weight, md.query_boundaries
         else:
@@ -427,8 +967,22 @@ class StreamingGBDT:
             label = ds.metadata.label
             weight = ds.metadata.weight
             qb = ds.metadata.query_boundaries
-        return eval_metric_rows(self.objective, self.metrics, name,
-                                raw, label, weight, qb, 1)
+        res = eval_metric_rows(self.objective, self.metrics, name,
+                               raw, label, weight, qb, 1)
+        if self.R > 1 and jax.process_count() > 1:
+            # every rank must reach the SAME early-stop decision or the
+            # survivors deadlock in the next per-level collective —
+            # local-shard metrics diverge, so rank 0's values are
+            # broadcast (one small allgather; the engine loop calls
+            # eval_set in lockstep on every rank)
+            from jax.experimental import multihost_utils
+            vals = np.asarray([v for (_, _, v, _) in res], np.float64)
+            g = np.asarray(
+                multihost_utils.process_allgather(vals)).reshape(
+                    jax.process_count(), -1)
+            res = [(nm, mt, float(v0), hb)
+                   for (nm, mt, _, hb), v0 in zip(res, g[0])]
+        return res
 
     def rollback_one_iter(self):
         log.fatal(self._UNSUPPORTED_MSG.format(what="rollback"))
@@ -438,12 +992,6 @@ class StreamingGBDT:
             self.train_one_iter()
 
     # -------------------------------------------------------- training
-    def _blocks(self):
-        for b in range(self.n_blocks):
-            lo = b * self.block_rows
-            hi = min(self.n, lo + self.block_rows)
-            yield b, lo, hi
-
     def _pad_block(self, arr, lo, hi, fill=0):
         out = arr[lo:hi]
         if hi - lo < self.block_rows:
@@ -457,6 +1005,94 @@ class StreamingGBDT:
         return {"leaf": z - 1, "feat": z, "thr": z, "dl": z,
                 "new_leaf": z, "nb": z, "hn": z}
 
+    def _level_hists(self, table, frontier_np, sampf, sampi):
+        """One streamed pass over every local rank's blocks: apply the
+        pending split table, accumulate each rank's [K, F, B, 3] level
+        histogram across its blocks — NO collective here; the single
+        per-level reduction happens in the find program."""
+        from .. import obs
+        n_ranks = len(self._ranks)
+        tbl_dev, frontier_dev, sampf_dev, sampi_dev = [], [], [], []
+        for rk in self._ranks:
+            dev = rk["dev"]
+            frontier_dev.append(self._put(frontier_np, dev))
+            tbl_dev.append({k: self._put(v, dev)
+                            for k, v in table.items()})
+            sampf_dev.append(self._put(sampf, dev))
+            sampi_dev.append(self._put(sampi, dev))
+        hists = [None] * n_ranks
+        prev = [None] * n_ranks  # per rank: (bins_blk, hist-after-it)
+        iters = [list(self._rank_blocks(ri)) for ri in range(n_ranks)]
+        blocks = 0
+        # BLOCK-STEP-MAJOR over the ranks: dispatch step s for every
+        # rank before host-blocking on any rank's step s-1, so all
+        # local devices compute concurrently (rank-major order would
+        # serialize the devices to ~1/R utilization single-process);
+        # each rank still accumulates ITS blocks in order, so the
+        # partial sums are unchanged bit for bit.
+        for step in range(max(len(it) for it in iters)):
+            for ri, rk in enumerate(self._ranks):
+                if step >= len(iters[ri]):
+                    continue
+                b, lo, hi = iters[ri][step]
+                bins_blk = self._put(
+                    self._pad_block(self.binned, lo, hi), rk["dev"])
+                off = np.int32(rk["goff"] + (lo - rk["lo"]))
+                leaf_new, h_blk = self._sweep(
+                    bins_blk, self._score_dev[ri][b],
+                    self._label_dev[ri][b], self._weight_dev[ri][b],
+                    np.int32(hi - lo), self._leaf_dev[ri][b],
+                    tbl_dev[ri], frontier_dev[ri], off, sampf_dev[ri],
+                    sampi_dev[ri])
+                self._leaf_dev[ri][b] = leaf_new    # stays on device
+                hists[ri] = (h_blk if hists[ri] is None
+                             else hists[ri] + h_blk)
+                blocks += 1
+                # throttle + free with a per-rank 2-block in-flight
+                # window: unthrottled async dispatch would enqueue
+                # EVERY block's ~256 MB device buffer before the
+                # device drains one — at 128 blocks that is ~34 GB of
+                # live transients and an OOM (observed at the 32 GiB
+                # proof shape). Blocking on the rank's PREVIOUS block
+                # keeps upload of block s+1 overlapped with compute of
+                # block s while bounding transients to ~512 MB/rank.
+                if prev[ri] is not None:
+                    jax.block_until_ready(prev[ri][1])
+                    prev[ri][0].delete()
+                prev[ri] = (bins_blk, hists[ri])
+        for ri in range(n_ranks):
+            if prev[ri] is not None:
+                jax.block_until_ready(prev[ri][1])
+                prev[ri][0].delete()
+        self.comm_stats["blocks_scanned"] += blocks
+        if obs.enabled():
+            obs.inc("stream.blocks_scanned", blocks)
+        return hists
+
+    def _find_level(self, hists, allowed_dev, eu, scale):
+        """The ONE per-level collective + split search: returns the
+        packed [K_pad, 13] host array (identical on every rank)."""
+        from .. import obs
+        self.comm_stats["levels"] += 1
+        if self.R == 1:
+            return np.asarray(self._find(hists[0], allowed_dev, eu,
+                                         scale), np.float64)
+        t0 = time.perf_counter()
+        hist_g = self._global_of([h[None] for h in hists])
+        bests = np.asarray(self._find_sharded(hist_g, allowed_dev, eu,
+                                              scale), np.float64)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        K_pad = int(hists[0].shape[0])
+        payload = K_pad * self.num_features * self.B * 4 \
+            * (2 if self._packed_wire else 3)
+        self.comm_stats["allreduce_calls"] += 1
+        self.comm_stats["allreduce_bytes"] += payload
+        if obs.enabled():
+            obs.inc("comm.allreduce_calls")
+            obs.inc("comm.allreduce_bytes", payload)
+            obs.observe("comm.allreduce_ms", dt_ms)
+        return bests
+
     def train_one_iter(self) -> None:
         L = int(self.config.num_leaves)
         max_depth = int(self.config.max_depth)
@@ -468,9 +1104,12 @@ class StreamingGBDT:
             allowed[:] = False
             allowed[self._rng.choice(F, size=k, replace=False)] = True
         allowed_dev = jnp.asarray(allowed)
+        sampf, sampi, scale = self._round_sampling()
+        scale_dev = jnp.asarray(scale)
 
-        for b in range(self.n_blocks):
-            self._leaf_dev[b] = self._zeros_leaf
+        for ri in range(len(self._ranks)):
+            for b in range(self._ranks[ri]["n_blocks"]):
+                self._leaf_dev[ri][b] = self._zeros_leaf[ri]
         nl = 1
         nn = 0
         # per-node host arrays (grown as splits land)
@@ -491,45 +1130,18 @@ class StreamingGBDT:
             # pruned-frontier shape recompiles (~30 s each on the
             # tunneled chip, dwarfing the sweep itself)
             K_pad = 1 << max(0, (K - 1)).bit_length()
-            frontier_dev = jnp.asarray(np.asarray(
-                frontier + [-1] * (K_pad - K), np.int32))
-            tbl_dev = {k: jnp.asarray(v) for k, v in table.items()}
-            hist = None
-            prev = None          # (bins_blk, hist-after-that-block)
-            for b, lo, hi in self._blocks():
-                bins_blk = jnp.asarray(self._pad_block(self.binned, lo, hi))
-                leaf_new, h_blk = self._sweep(
-                    bins_blk, self._score_dev[b], self._label_dev[b],
-                    self._weight_dev[b], np.int32(hi - lo),
-                    self._leaf_dev[b], tbl_dev, frontier_dev)
-                self._leaf_dev[b] = leaf_new    # stays on device
-                hist = h_blk if hist is None else hist + h_blk
-                # throttle + free with a 2-block in-flight window:
-                # unthrottled async dispatch would enqueue EVERY
-                # block's ~256 MB device buffer before the device
-                # drains one — at 128 blocks that is ~34 GB of live
-                # transients and an OOM (observed at the 32 GiB proof
-                # shape). Blocking on the PREVIOUS block keeps upload
-                # of block b+1 overlapped with compute of block b
-                # while bounding transients to ~512 MB.
-                if prev is not None:
-                    jax.block_until_ready(prev[1])
-                    prev[0].delete()
-                prev = (bins_blk, hist)
-            if prev is not None:
-                jax.block_until_ready(prev[1])
-                prev[0].delete()
-            # leaf totals straight from the histogram: any one
-            # feature's bins partition the leaf's rows
-            parent_sums = jnp.sum(hist[:, 0, :, :], axis=1)
+            frontier_np = np.asarray(frontier + [-1] * (K_pad - K),
+                                     np.int32)
+            hists = self._level_hists(table, frontier_np, sampf, sampi)
             # per-level extra_trees uniforms (one random threshold per
-            # (leaf, feature)); None when off — the jitted find's
-            # in_axes already match
+            # (leaf, feature)); None when off — drawn from the shared
+            # host rng, so every rank draws the same field
             eu = (jnp.asarray(self._rng.random((K_pad, F)), jnp.float32)
-                  if self._scfg.extra_trees else None)
-            # ONE device->host pull per level (packed [K_pad, 13])
-            bests = np.asarray(self._find(hist, parent_sums,
-                                          allowed_dev, eu), np.float64)
+                  if self._scfg.extra_trees
+                  else np.zeros((1, 1), np.float32))
+            # ONE device->host pull per level (packed [K_pad, 13]),
+            # and — sharded — ONE histogram collective per level
+            bests = self._find_level(hists, allowed_dev, eu, scale_dev)
             for i, lf in enumerate(frontier):
                 leaf_sums[lf] = bests[i, 10:13]
             table = self._empty_table()
@@ -598,23 +1210,55 @@ class StreamingGBDT:
         for lf in range(nl):
             leaf_out[lf] = self._leaf_out_np(leaf_sums[lf][0],
                                              leaf_sums[lf][1])
-        tbl_dev = {k: jnp.asarray(v) for k, v in table.items()}
-        leaf_out_dev = jnp.asarray(leaf_out)
-        prev = None
-        for b, lo, hi in self._blocks():
-            bins_blk = jnp.asarray(self._pad_block(self.binned, lo, hi))
-            leaf_new, score_new = self._final(
-                bins_blk, self._score_dev[b], self._leaf_dev[b],
-                tbl_dev, leaf_out_dev)
-            self._leaf_dev[b] = leaf_new
-            self._score_dev[b] = score_new
-            if prev is not None:
-                jax.block_until_ready(prev[1])
-                prev[0].delete()
-            prev = (bins_blk, score_new)
-        if prev is not None:
-            jax.block_until_ready(prev[1])
-            prev[0].delete()
+        from .. import obs
+        n_ranks = len(self._ranks)
+        tbl_dev, leaf_out_dev = [], []
+        for rk in self._ranks:
+            tbl_dev.append({k: self._put(v, rk["dev"])
+                            for k, v in table.items()})
+            leaf_out_dev.append(self._put(leaf_out, rk["dev"]))
+        maxs = [None] * n_ranks
+        counts = [None] * n_ranks
+        prev = [None] * n_ranks
+        iters = [list(self._rank_blocks(ri)) for ri in range(n_ranks)]
+        blocks = 0
+        # block-step-major like _level_hists: keep every local device
+        # busy while the per-rank 2-block window bounds transients
+        for step in range(max(len(it) for it in iters)):
+            for ri, rk in enumerate(self._ranks):
+                if step >= len(iters[ri]):
+                    continue
+                b, lo, hi = iters[ri][step]
+                bins_blk = self._put(
+                    self._pad_block(self.binned, lo, hi), rk["dev"])
+                leaf_new, score_new, m_blk, c_blk = self._final(
+                    bins_blk, self._score_dev[ri][b],
+                    self._label_dev[ri][b], self._weight_dev[ri][b],
+                    np.int32(hi - lo), self._leaf_dev[ri][b],
+                    tbl_dev[ri], leaf_out_dev[ri])
+                self._leaf_dev[ri][b] = leaf_new
+                self._score_dev[ri][b] = score_new
+                blocks += 1
+                if self._track_stats:
+                    # next round's statistics fold out of this sweep
+                    # (gradients of the NEW score) — no extra pass
+                    maxs[ri] = (m_blk if maxs[ri] is None
+                                else jnp.maximum(maxs[ri], m_blk))
+                    counts[ri] = (c_blk if counts[ri] is None
+                                  else counts[ri] + c_blk)
+                if prev[ri] is not None:
+                    jax.block_until_ready(prev[ri][1])
+                    prev[ri][0].delete()
+                prev[ri] = (bins_blk, score_new)
+        for ri in range(n_ranks):
+            if prev[ri] is not None:
+                jax.block_until_ready(prev[ri][1])
+                prev[ri][0].delete()
+        self.comm_stats["blocks_scanned"] += blocks
+        if obs.enabled():
+            obs.inc("stream.blocks_scanned", blocks)
+        if self._track_stats:
+            self._pending_stats = list(zip(maxs, counts))
 
         tree_arrays = {
             "num_leaves": nl,

@@ -299,17 +299,27 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # the binned matrix would exceed ~60% of device HBM (the resident
     # engine fatals at 92%); "true" forces the streaming engine;
     # "false" always stays resident (and hits the HBM guard when too
-    # big). Streaming supports single-output objectives on numerical
-    # features — see StreamingGBDT's docstring for the full contract.
+    # big). With tree_learner=data the streamed path SHARDS rows over
+    # the mesh (each rank streams only its own blocks; one packed
+    # collective per tree level — docs/perf.md "Streamed x sharded"),
+    # and auto engages when the PER-RANK shard would still exceed the
+    # budget. Streaming supports single-output objectives on numerical
+    # features, incl. bagging/GOSS/quantized gradients — see
+    # StreamingGBDT's docstring for the full contract.
     "tpu_streaming": _P("str", "auto"),
-    # rows per streamed block (0 = auto: ~256 MB of binned data)
+    # rows per streamed block (0 = auto: ~256 MB of binned data);
+    # applies per RANK under sharded streaming — a rank whose row
+    # range would yield zero blocks fatals at construction
     "tpu_stream_block_rows": _P("int", 0),
     # quantized-histogram collective wire: pack each (g,h) level-sum
     # pair into one int32 (g high 16 bits, h low 16) so the psum /
     # psum_scatter payload drops to 2/3 (docs/perf.md packed-wire
-    # design). Exact: a per-round guard psum bounds the global level
-    # sums and falls back to the f32 reduce on any overflow risk or
-    # negative hessian. No effect without use_quantized_grad + a mesh.
+    # design; shared helper learner/collective.py — the resident
+    # data-parallel learner AND the sharded streaming engine both
+    # reduce through it). Exact: a per-round guard psum bounds the
+    # global level sums and falls back to the f32 reduce on any
+    # overflow risk or negative hessian. No effect without
+    # use_quantized_grad + a mesh.
     "tpu_hist_packed_wire": _P("bool", True),
     # per-iteration finite checks on tree outputs/scores (the aux
     # NaN-guard subsystem; costs a host sync per iteration)

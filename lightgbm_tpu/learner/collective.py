@@ -1,0 +1,80 @@
+"""Shared cross-device histogram reduction — the packed int32 wire.
+
+Reference: the socket ``Network::Allreduce`` the reference learners call
+on their per-machine histograms (data_parallel_tree_learner.cpp,
+SURVEY.md §3.4, UNVERIFIED — empty mount). TPU-native replacement: ONE
+``psum`` (or ``psum_scatter`` for ReduceScatter feature ownership) over
+a mesh axis, optionally on the packed quantized wire
+(``tpu_hist_packed_wire``, docs/perf.md "packed-wire design").
+
+Factored out of ``learner/serial.py``'s ``grow_tree`` closures so the
+out-of-core streaming engine (boosting/streaming.py) reduces its
+accumulated per-level histograms through the SAME wire instead of
+growing a second reduction path: both callers get the identical
+packing, guard, and fallback semantics from one definition.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["hist_allreduce"]
+
+
+def hist_allreduce(h: jax.Array, axis_name: str, *,
+                   scatter: bool = False, scatter_dim: int = 1,
+                   packed: bool = False) -> jax.Array:
+    """Reduce a ``[..., 3]`` (grad, hess, count) histogram over a mesh
+    axis: one collective per call.
+
+    Args:
+      h: local partial histogram, last dim = (g, h, count) channels.
+      axis_name: mesh axis to reduce over.
+      scatter: use ``psum_scatter`` (ReduceScatter feature ownership —
+        each device receives the summed slice of ``scatter_dim`` it
+        owns) instead of a full ``psum``.
+      packed: engage the packed quantized wire — each (g, h) level-sum
+        pair rides ONE int32 (g in the high 16 bits, non-negative h in
+        the low 16) and count rides a second int32: 2/3 of the f32
+        payload, bit-exact. Per-lane modular addition is carry-free
+        because the low (hessian) lane is non-negative and its GLOBAL
+        sum stays under 2^15 — guaranteed by a 3-scalar guard psum of
+        sum-of-local-extreme bounds (|Σ_d x_d| <= Σ_d max|x_d|); any
+        risk of int16 overflow (or a negative hessian from a custom
+        objective) falls back to the f32 reduction inside the same
+        jitted step. Only valid when ``h`` carries small integer
+        values (quantized gradient levels).
+
+    Returns the reduced histogram in the INPUT units — callers owning
+    a quantization scale rescale to real units themselves, after (and
+    outside) the reduction, so integer sums stay exact on the wire.
+    """
+    def _reduce(x):
+        if scatter:
+            return jax.lax.psum_scatter(x, axis_name,
+                                        scatter_dimension=scatter_dim,
+                                        tiled=True)
+        return jax.lax.psum(x, axis_name)
+
+    if not packed:
+        h = _reduce(h)
+    else:
+        def _packed_reduce(hh):
+            gi = hh[..., 0].astype(jnp.int32)
+            hi = hh[..., 1].astype(jnp.int32)
+            ci = hh[..., 2].astype(jnp.int32)
+            p = jnp.stack([(gi << 16) | (hi & 0xFFFF), ci], axis=-1)
+            p = _reduce(p)
+            g_out = (p[..., 0] >> 16).astype(jnp.float32)
+            h_out = (p[..., 0] & 0xFFFF).astype(jnp.float32)
+            return jnp.stack([g_out, h_out,
+                              p[..., 1].astype(jnp.float32)], axis=-1)
+
+        loc = jnp.stack([jnp.max(jnp.abs(h[..., 0])),
+                         jnp.max(h[..., 1]),
+                         jnp.maximum(-jnp.min(h[..., 1]), 0.0)])
+        glob = jax.lax.psum(loc, axis_name)
+        safe = ((glob[0] < 32767.0) & (glob[1] < 32767.0)
+                & (glob[2] <= 0.0))
+        h = jax.lax.cond(safe, _packed_reduce, _reduce, h)
+    return h

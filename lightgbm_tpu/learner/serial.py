@@ -365,53 +365,21 @@ def grow_tree(bins: jax.Array, vals: jax.Array,
                   and bool(cfg.axis_name)
                   and not (mode_voting or mode_feature))
 
-    def _reduce_op(x):
-        """The collective itself — shared by the f32 and packed paths."""
-        if mode_scatter:
-            # the reference's ReduceScatter: each device receives the
-            # summed histograms of the features it owns
-            return jax.lax.psum_scatter(x, cfg.axis_name,
-                                        scatter_dimension=1, tiled=True)
-        return jax.lax.psum(x, cfg.axis_name)
-
-    def _packed_reduce(h):
-        """(g,h) as two int16 halves of one int32 (docs/perf.md packed-
-        wire design): per-lane modular addition is carry-free because
-        the low (hessian) lane is non-negative and its GLOBAL sum stays
-        under 2^15 — guaranteed by the guard in hist_reduce. g is
-        recovered by arithmetic shift (sign-extends), h by masking."""
-        gi = h[..., 0].astype(jnp.int32)
-        hi = h[..., 1].astype(jnp.int32)
-        ci = h[..., 2].astype(jnp.int32)
-        packed = jnp.stack(
-            [(gi << 16) | (hi & 0xFFFF), ci], axis=-1)
-        packed = _reduce_op(packed)
-        g_out = (packed[..., 0] >> 16).astype(jnp.float32)
-        h_out = (packed[..., 0] & 0xFFFF).astype(jnp.float32)
-        return jnp.stack([g_out, h_out,
-                          packed[..., 1].astype(jnp.float32)], axis=-1)
-
     def hist_reduce(h):
-        """Mode-specific cross-device histogram reduction. With
-        quantized gradients (use_quantized_grad), ``vals`` hold small
-        integer levels — EXACT in the bf16 matmul and reduced as ints
-        (the reference's int-histogram allreduce,
-        cuda_gradient_discretizer.cu) — and are rescaled to real units
-        here, right after the reduction."""
+        """Mode-specific cross-device histogram reduction — ONE
+        collective through the shared packed-int32 wire
+        (learner/collective.py; the streaming engine reduces through
+        the same helper). With quantized gradients
+        (use_quantized_grad), ``vals`` hold small integer levels —
+        EXACT in the bf16 matmul and reduced as ints (the reference's
+        int-histogram allreduce, cuda_gradient_discretizer.cu) — and
+        are rescaled to real units here, right after the reduction."""
+        from .collective import hist_allreduce
         if use_packed:
-            # guard: sum over devices of each device's extreme level
-            # sums bounds the global per-bin sums (|Σ_d x_d| <=
-            # Σ_d max|x_d|); 3 scalars ride one tiny psum. Negative
-            # hessians (custom objectives) also force the f32 path.
-            loc = jnp.stack([jnp.max(jnp.abs(h[..., 0])),
-                             jnp.max(h[..., 1]),
-                             jnp.maximum(-jnp.min(h[..., 1]), 0.0)])
-            glob = jax.lax.psum(loc, cfg.axis_name)
-            safe = ((glob[0] < 32767.0) & (glob[1] < 32767.0)
-                    & (glob[2] <= 0.0))
-            h = jax.lax.cond(safe, _packed_reduce, _reduce_op, h)
+            h = hist_allreduce(h, cfg.axis_name, scatter=mode_scatter,
+                               packed=True)
         elif cfg.axis_name and not (mode_voting or mode_feature):
-            h = _reduce_op(h)
+            h = hist_allreduce(h, cfg.axis_name, scatter=mode_scatter)
         if chan_scale is not None:
             h = h * chan_scale
         return h

@@ -27,6 +27,15 @@ Pod recipe (multi-host hardware): run YOUR script once per host;
 in it call ``run_worker(rank=None, ...)`` (auto-discovery on TPU
 pods) or pass coordinator/rank explicitly. ``train_distributed``
 itself is the localhost many-process convenience wrapper around it.
+
+Out-of-core composition: with ``tpu_streaming`` ("true", or "auto"
+when even the per-rank binned shard exceeds HBM) each worker routes
+onto the SHARDED streaming engine — its shard's bins stay in host RAM
+and stream through the device block by block, with ONE packed
+collective of the accumulated histograms per tree level
+(docs/perf.md "Streamed x sharded"). Same ``data_fn`` row-shard
+contract, same rank-0 model collection; datasets beyond one host's
+RAM x beyond one device's HBM become a worker-count question.
 """
 from __future__ import annotations
 

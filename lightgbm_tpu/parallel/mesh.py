@@ -49,7 +49,18 @@ FEATURE_AXIS = "feature"
 
 __all__ = ["Mesh", "NamedSharding", "P", "shard_map", "DATA_AXIS",
            "FEATURE_AXIS", "create_data_mesh", "num_devices",
-           "shard_rows", "replicate"]
+           "shard_rows", "replicate", "local_mesh_positions"]
+
+
+def local_mesh_positions(mesh: Mesh):
+    """(positions, devices) of THIS process's addressable devices in
+    mesh-flat order — the rank ids a multi-process engine computes for
+    locally (the streaming engine's shard layout; one device per
+    process on CPU gangs, all of them single-process)."""
+    me = jax.process_index()
+    flat = list(mesh.devices.flat)
+    pos = [i for i, d in enumerate(flat) if d.process_index == me]
+    return pos, [flat[i] for i in pos]
 
 
 def num_devices() -> int:

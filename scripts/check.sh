@@ -50,13 +50,23 @@ OBS_JSON=/tmp/_check_obs_metrics.jsonl
 rm -f "$OBS_JSON"
 python bench.py --rows 300000 --iters 5 --smoke --metrics-json "$OBS_JSON"
 
+# streamed x sharded dryrun (docs/perf.md "Streamed x sharded"): the
+# 2-device streaming case must stay BIT-EQUAL to single-shard
+# streaming with one collective per level; its status rides the obs
+# line below so scripts/obs_trend.py watches it run-over-run
+STREAM_DRYRUN=1
+XLA_FLAGS="--xla_force_host_platform_device_count=2 ${XLA_FLAGS:-}" \
+JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
+python -c "import __graft_entry__ as g; g.dryrun_multichip(2, only=('streaming',))" \
+  || STREAM_DRYRUN=0
+
 # machine-readable obs line appended next to the plain timing line:
 # dots/seconds from this run plus compile count and peak-HBM estimate
 # read back from the snapshot. A malformed dump FAILS the gate — a
 # check that silently skips its own telemetry is how telemetry rots.
-python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" <<'PY' >> scripts/check_timings.log
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" <<'PY' >> scripts/check_timings.log
 import json, sys, time
-path, mode, dots, secs, rev = sys.argv[1:6]
+path, mode, dots, secs, rev, stream_ok = sys.argv[1:7]
 try:
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     snap = json.loads(lines[-1])
@@ -86,8 +96,17 @@ print("obs " + json.dumps({
     # this number jumping back to the masked product
     "hist_rows_scanned": gauge("hist.rows_scanned"),
     "hist_partition": gauge("bench.hist_partition"),
+    # streamed-training trajectory + the sharded-streaming dryrun pin
+    "stream_rows_per_sec": gauge("bench.stream_rows_per_sec"),
+    "stream_shards": gauge("bench.stream_shards"),
+    "stream_dryrun": int(stream_ok),
 }))
 PY
+
+if [[ "$STREAM_DRYRUN" != 1 ]]; then
+  echo "check.sh: streamed-sharded dryrun FAILED (status logged)"
+  exit 4
+fi
 
 # perf-regression sentinel (CHECK_TREND=1 to enforce): compare the obs
 # line just appended against the trailing same-mode median; a >15%

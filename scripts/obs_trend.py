@@ -16,7 +16,10 @@ regressed past its threshold —
   by a couple);
 - ``peak_hbm_gib`` UP by more than ``--max-hbm-up``;
 - ``secs`` (suite wall clock) UP by more than ``--max-secs-up`` at a
-  non-lower dot count (fewer dots = different suite, not a slowdown).
+  non-lower dot count (fewer dots = different suite, not a slowdown);
+- ``stream_dryrun`` == 0 in the NEWEST run (absolute, no baseline
+  needed): the streamed-sharded dryrun check.sh runs diverged from
+  single-shard streaming or crashed.
 
 No (or not enough) history exits 0 — the first run after a wipe stays
 green. A signal missing from either side of the comparison is skipped
@@ -109,6 +112,15 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
     if not entries:
         return []
     newest = entries[-1]
+    failures: List[str] = []
+    # the streamed-sharded dryrun pin needs no baseline: a 0 in the
+    # newest run means sharded streaming diverged from single-shard
+    # (or crashed) — an absolute failure, not a trend
+    if _num(newest, "stream_dryrun") == 0.0:
+        failures.append(
+            "streamed-sharded dryrun FAILED (stream_dryrun=0): the "
+            "2-device streaming case diverged from single-shard "
+            "streaming or crashed")
     mode = newest.get("mode")
     # rejected entries (previous sentinel failures) never become
     # baseline — a persistent regression re-run N times must keep
@@ -117,8 +129,9 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
                if e.get("mode") == mode and not e.get("_rejected")]
     history = history[-window:]
     if not history:
-        return []    # first run (or first in this mode): no baseline
-    failures: List[str] = []
+        # first run (or first in this mode): no trend baseline — only
+        # the absolute checks above apply
+        return failures
 
     ips_now = _num(newest, "bench_iters_per_sec")
     ips_med = _median_of(history, "bench_iters_per_sec")
@@ -191,10 +204,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     entries = parse_obs_lines(text)
-    if len(entries) < 2:
-        print(f"obs_trend: {len(entries)} obs line(s) in {args.log}; "
-              f"need >= 2 for a trend — OK")
+    if not entries:
+        print(f"obs_trend: no obs lines in {args.log}; nothing to "
+              f"compare")
         return 0
+    # a single entry has no trend baseline, but the absolute checks
+    # (the stream_dryrun pin) still apply to it
     failures = check_trend(entries, args.window, args.max_ips_drop,
                            args.max_compile_up, args.compile_slack,
                            args.max_hbm_up, args.max_secs_up)

@@ -135,3 +135,24 @@ def test_modes_compare_separately(tmp_path):
     lines += [_obs_line(mode="smoke", secs=310, dots=38)]
     rc, out = _run(tmp_path, lines)
     assert rc == 0, out
+
+
+def test_stream_dryrun_failure_fails_even_without_history(tmp_path):
+    """The streamed-sharded dryrun pin is ABSOLUTE: stream_dryrun=0 in
+    the newest entry fails the sentinel with or without a baseline
+    (sharded-vs-single-shard divergence is never a 'trend')."""
+    bad = _obs_line()
+    bad = "obs " + json.dumps(
+        dict(json.loads(bad[len("obs "):]), stream_dryrun=0))
+    # no history at all
+    rc, out = _run(tmp_path, [bad])
+    assert rc == 1, out
+    assert "stream_dryrun" in out
+    # with healthy history it still fails
+    rc, out = _run(tmp_path, [_obs_line() for _ in range(4)] + [bad])
+    assert rc == 1, out
+    # and a passing dryrun (or an old line without the key) stays green
+    ok = "obs " + json.dumps(
+        dict(json.loads(_obs_line()[len("obs "):]), stream_dryrun=1))
+    rc, out = _run(tmp_path, [_obs_line() for _ in range(4)] + [ok])
+    assert rc == 0, out

@@ -93,12 +93,16 @@ def test_streaming_feature_fraction_and_l1():
 
 
 def test_streaming_rejects_unsupported():
+    # GOSS / bagging / quantized gradients are streaming-supported now
+    # (PR 7, the sharded streamed path); the structured-constraint
+    # features and non-row-sharding learners still gate out
     X, y = _data(n=2_000)
     from lightgbm_tpu.utils.log import LightGBMError
-    for extra in ({"data_sample_strategy": "goss"},
-                  {"num_class": 3, "objective": "multiclass"},
+    for extra in ({"num_class": 3, "objective": "multiclass"},
                   {"linear_tree": True},
-                  {"boosting": "dart"}):
+                  {"boosting": "dart"},
+                  {"tree_learner": "voting"},
+                  {"monotone_constraints": [1] * 10}):
         with pytest.raises(LightGBMError):
             lgb.train(dict(BASE, tpu_streaming="true", **extra),
                       lgb.Dataset(X, label=y.astype(float)),
@@ -139,19 +143,22 @@ def test_streaming_compatible_never_routes_fatal_configs():
     """_streaming_compatible must be a SUBSET of what StreamingGBDT
     accepts: auto-routing a config into its _no() fatals would turn a
     train() that the resident engine handles into a crash (ADVICE r5:
-    use_quantized_grad and bare cegb_tradeoff were missing gates)."""
+    use_quantized_grad and bare cegb_tradeoff were missing gates;
+    PR 7 lifted the quantization gate — explicit use_quantized_grad is
+    now streaming-compatible and must construct, not fatal)."""
     from lightgbm_tpu.boosting import _streaming_compatible
     from lightgbm_tpu.config import Config
-    for extra in ({"use_quantized_grad": True},
-                  {"cegb_tradeoff": 2.0}):
-        cfg = Config(dict(BASE, **extra))
-        assert not _streaming_compatible(cfg), extra
-    # the resident engine still trains these fine
+    cfg = Config(dict(BASE, cegb_tradeoff=2.0))
+    assert not _streaming_compatible(cfg)
+    assert _streaming_compatible(Config(dict(BASE,
+                                             use_quantized_grad=True)))
+    # the resident engine still trains the incompatible config fine,
+    # and the now-compatible one trains on the STREAMING engine
     X, y = _data(n=2_000)
-    for extra in ({"use_quantized_grad": True},
-                  {"cegb_tradeoff": 2.0}):
-        lgb.train(dict(BASE, **extra), lgb.Dataset(X, label=y),
-                  num_boost_round=2)
+    lgb.train(dict(BASE, cegb_tradeoff=2.0), lgb.Dataset(X, label=y),
+              num_boost_round=2)
+    lgb.train(dict(BASE, use_quantized_grad=True, tpu_streaming="true"),
+              lgb.Dataset(X, label=y), num_boost_round=2)
 
 
 def test_streaming_extra_trees_binds():

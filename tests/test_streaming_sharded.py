@@ -1,0 +1,311 @@
+"""Streamed x sharded training (boosting/streaming.py tree_learner=
+data): each rank streams only its own row shard's blocks, accumulates
+its local [K, F, B, 3] level histogram, and ONE psum / psum_scatter per
+tree level through the shared packed-int32 wire (learner/collective.py)
+makes every rank grow bit-identical trees.
+
+The acceptance invariants pinned here:
+* sharded trees BIT-IDENTICAL to single-shard streaming at 1/2/4
+  shards (plain f32, quantized+packed wire, GOSS, bagging);
+* exactly ONE histogram collective per tree level regardless of block
+  count (the ``comm.allreduce_calls`` counter);
+* bagging and GOSS train on the streaming engine, seed-reproducible,
+  quality-par with the in-core path;
+* ``_streaming_compatible`` accepts a config IFF StreamingGBDT's
+  ``_no()`` gates do (the drift guard — PR 5 fixed two bugs from
+  exactly this drift);
+* a rank that would stream zero blocks fatals EARLY (mirrors
+  ``_cli_file_shard``'s row-count check);
+* ``tpu_streaming=auto`` routes an over-HBM mesh config onto the
+  sharded streaming path.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _data(n=16_000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 16, "max_depth": 4,
+        "verbosity": -1, "min_data_in_leaf": 20,
+        "tpu_streaming": "true", "tpu_stream_block_rows": 2_048}
+
+
+def _train(X, y, shards, rounds=5, **extra):
+    p = dict(BASE, **extra)
+    if shards > 1:
+        p["tree_learner"] = "data"
+        p["tpu_mesh_shape"] = shards
+    return lgb.train(p, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity across shard counts
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("extra", [
+    {},                                                  # plain f32
+    {"use_quantized_grad": True},                        # packed wire
+    {"use_quantized_grad": True,
+     "data_sample_strategy": "goss"},
+    {"bagging_fraction": 0.6, "bagging_freq": 2},
+], ids=["plain", "quant", "quant_goss", "bagging"])
+def test_sharded_bit_identical_to_single_shard(extra):
+    """1/2/4-shard streamed training must produce the same model text
+    byte for byte: per-rank partial histograms are exact sums (integer
+    level sums under quantization; bf16-rounded contributions with
+    24-bit f32 headroom otherwise), so the per-level reduction is
+    association-free, and the bagging/GOSS row hash keys on GLOBAL row
+    indices."""
+    X, y = _data()
+    texts = {s: _train(X, y, s, **extra).model_to_string()
+             for s in (1, 2, 4)}
+    assert texts[1] == texts[2]
+    assert texts[1] == texts[4]
+
+
+def test_sharded_scatter_and_psum_wires_agree():
+    """tpu_hist_reduce=scatter (psum_scatter + best-split election)
+    and =psum are two wires for the same reduction — identical trees,
+    both bit-equal to the single-shard run."""
+    X, y = _data(seed=2)
+    ref = _train(X, y, 1, use_quantized_grad=True).model_to_string()
+    for wire in ("scatter", "psum"):
+        t = _train(X, y, 2, use_quantized_grad=True,
+                   tpu_hist_reduce=wire).model_to_string()
+        assert t == ref, wire
+
+
+# ---------------------------------------------------------------------------
+# one collective per level, regardless of block count
+# ---------------------------------------------------------------------------
+def test_one_allreduce_per_level_any_block_count():
+    """The acceptance pin: the number of histogram collectives equals
+    the number of tree LEVELS — never scaling with how many blocks the
+    rows were cut into (the accumulate-then-reduce design)."""
+    X, y = _data(n=12_000)
+    engines = {}
+    for blk in (2_048, 16_384):
+        bst = _train(X, y, 2, rounds=4, tpu_stream_block_rows=blk)
+        engines[blk] = bst.engine.comm_stats
+    a, b = engines[2_048], engines[16_384]
+    # more blocks were scanned at the small block size...
+    assert a["blocks_scanned"] > b["blocks_scanned"]
+    # ...but the collective count is pinned to the level count
+    assert a["allreduce_calls"] == a["levels"]
+    assert b["allreduce_calls"] == b["levels"]
+    assert a["allreduce_calls"] == b["allreduce_calls"]
+    assert a["allreduce_bytes"] > 0
+
+
+def test_comm_obs_counters_registered():
+    """stream.blocks_scanned / comm.allreduce_* land in the obs
+    registry (docs/observability.md catalogue) when metrics are on."""
+    from lightgbm_tpu import obs
+    obs.reset()
+    obs.enable(metrics=True)
+    try:
+        X, y = _data(n=8_000)
+        bst = _train(X, y, 2, rounds=3)
+        snap = obs.snapshot()
+        names = {m["name"] for m in snap["metrics"]}
+        assert "stream.blocks_scanned" in names
+        assert "comm.allreduce_calls" in names
+        assert "comm.allreduce_bytes" in names
+        assert "comm.allreduce_ms" in names
+        got = {m["name"]: m for m in snap["metrics"]
+               if not m.get("labels")}
+        cs = bst.engine.comm_stats
+        assert got["comm.allreduce_calls"]["value"] == \
+            cs["allreduce_calls"]
+        assert got["comm.allreduce_bytes"]["value"] == \
+            cs["allreduce_bytes"]
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# bagging / GOSS on the streaming engine
+# ---------------------------------------------------------------------------
+def test_streaming_bagging_seeded_and_quality_par():
+    X, y = _data(seed=7)
+    kw = dict(bagging_fraction=0.6, bagging_freq=2, rounds=10)
+    t1 = _train(X, y, 1, bagging_seed=3, **kw).model_to_string()
+    t2 = _train(X, y, 1, bagging_seed=3, **kw).model_to_string()
+    t3 = _train(X, y, 1, bagging_seed=9, **kw).model_to_string()
+    assert t1 == t2            # same seed reproduces exactly
+    assert t1 != t3            # different seed actually re-draws
+    # bagging actually drops rows: trees differ from the full-data run
+    assert t1 != _train(X, y, 1, rounds=10).model_to_string()
+    # quality parity vs the in-core engine's bagging at equal rounds
+    bs = _train(X, y, 1, bagging_seed=3, **kw)
+    resident = lgb.train(
+        dict(BASE, tpu_streaming="false", bagging_fraction=0.6,
+             bagging_freq=2, bagging_seed=3),
+        lgb.Dataset(X, label=y), num_boost_round=10)
+    acc_s = np.mean((bs.predict(X) > 0.5) == y)
+    acc_r = np.mean((resident.predict(X) > 0.5) == y)
+    assert abs(acc_s - acc_r) < 0.02
+
+
+def test_streaming_goss_quality_par_and_block_invariant():
+    """GOSS on the streaming engine: the global bucketed |g*h|
+    threshold keeps quality par with the in-core exact top-k, and the
+    hash-keyed sample is invariant to the block cut."""
+    X, y = _data(seed=11)
+    g = dict(data_sample_strategy="goss", rounds=10)
+    bs = _train(X, y, 1, **g)
+    resident = lgb.train(
+        dict(BASE, tpu_streaming="false", data_sample_strategy="goss"),
+        lgb.Dataset(X, label=y), num_boost_round=10)
+    acc_s = np.mean((bs.predict(X) > 0.5) == y)
+    acc_r = np.mean((resident.predict(X) > 0.5) == y)
+    assert acc_s > 0.8
+    assert abs(acc_s - acc_r) < 0.02
+    # block-cut invariance (the same rows keep the same draws)
+    ta = _train(X, y, 1, tpu_stream_block_rows=30_000,
+                **g).model_to_string()
+    assert ta == bs.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# drift guard: _streaming_compatible <=> StreamingGBDT._no() gates
+# ---------------------------------------------------------------------------
+_GATE_SWEEP = [
+    ({}, True),
+    ({"tree_learner": "data"}, True),
+    ({"data_sample_strategy": "goss"}, True),
+    ({"bagging_fraction": 0.5, "bagging_freq": 1}, True),
+    ({"pos_bagging_fraction": 0.5, "neg_bagging_fraction": 0.8,
+      "bagging_freq": 1}, True),
+    ({"use_quantized_grad": True}, True),
+    ({"extra_trees": True}, True),
+    ({"feature_fraction": 0.7}, True),
+    ({"objective": "regression"}, True),
+    ({"tree_learner": "voting"}, False),
+    ({"tree_learner": "feature"}, False),
+    ({"objective": "multiclass", "num_class": 3}, False),
+    ({"objective": "lambdarank"}, False),
+    ({"boosting": "dart"}, False),
+    ({"linear_tree": True}, False),
+    ({"monotone_constraints": [1, 0, 0, 0]}, False),
+    ({"interaction_constraints": [[0, 1], [2, 3]]}, False),
+    ({"cegb_tradeoff": 2.0}, False),
+    ({"cegb_penalty_split": 0.5}, False),
+    # int16 leaf-id cap: the resident engine trains this, streaming
+    # fatals — auto mode must keep it resident
+    ({"num_leaves": 40_000}, False),
+]
+
+
+@pytest.mark.parametrize("tweak,compat", _GATE_SWEEP,
+                         ids=[str(sorted(t)) for t, _ in _GATE_SWEEP])
+def test_streaming_gate_drift_guard(tweak, compat):
+    """_streaming_compatible(cfg) is True IFF StreamingGBDT.__init__
+    accepts cfg (numerical features; dataset-level gates excluded by
+    construction). Lifting or adding a gate must update BOTH sides or
+    this sweep goes red — the drift that produced two PR-5 bugs.
+    Seeds ROADMAP item 4's capability table."""
+    from lightgbm_tpu.boosting import _streaming_compatible
+    from lightgbm_tpu.boosting.streaming import StreamingGBDT
+    from lightgbm_tpu.config import Config
+    X, y = _data(n=640, f=4)
+    params = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+              "tpu_stream_block_rows": 64}
+    params.update(tweak)
+    cfg = Config(params)
+    assert _streaming_compatible(cfg) == compat, tweak
+    if "lambdarank" in str(tweak):
+        y = np.arange(len(y)) % 3  # graded relevance for the objective
+    ds = lgb.Dataset(X, label=y,
+                     group=[len(y)] if "lambdarank" in str(tweak)
+                     else None)
+    if compat:
+        eng = StreamingGBDT(cfg, ds)     # must construct, not fatal
+        assert eng.num_features == 4
+    else:
+        with pytest.raises(LightGBMError):
+            StreamingGBDT(cfg, ds)
+
+
+def test_sharded_zero_block_rank_fatals_early():
+    """n_rows < shards would hand some rank zero blocks and deadlock
+    the per-level collective — construction must fatal with a clear
+    message instead (mirrors _cli_file_shard's early fatal)."""
+    from lightgbm_tpu.boosting.streaming import StreamingGBDT
+    from lightgbm_tpu.config import Config
+    X, y = _data(n=5, f=3)
+    cfg = Config({"objective": "binary", "num_leaves": 4,
+                  "verbosity": -1, "tree_learner": "data",
+                  "min_data_in_leaf": 1})
+    with pytest.raises(LightGBMError, match="zero rows"):
+        StreamingGBDT(cfg, lgb.Dataset(X, label=y))
+
+
+# ---------------------------------------------------------------------------
+# auto routing: over-HBM mesh configs land on the sharded streamed path
+# ---------------------------------------------------------------------------
+def test_auto_routes_oversize_mesh_config_to_sharded_streaming(
+        monkeypatch):
+    """tpu_streaming=auto + tree_learner=data + a binned matrix whose
+    PER-RANK shard exceeds the HBM budget -> StreamingGBDT with R > 1
+    (the ROADMAP item 1 composition); a small per-rank shard keeps the
+    resident sharded engine."""
+    import lightgbm_tpu.utils.hbm as hbm
+    from lightgbm_tpu.boosting.streaming import StreamingGBDT
+    X, y = _data(n=8_000, f=6)
+    est = hbm.binned_device_bytes(8_000, 6, 1)
+    # per-rank (2 shards) estimate still over 60% of the "HBM" limit
+    monkeypatch.setattr(hbm, "hbm_bytes_limit",
+                        lambda: int(est / 2 / 0.61))
+    p = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+         "tree_learner": "data", "tpu_mesh_shape": 2,
+         "tpu_stream_block_rows": 2_048, "min_data_in_leaf": 5}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert isinstance(bst.engine, StreamingGBDT)
+    assert bst.engine.R == 2
+    # a roomy limit keeps the resident sharded engine
+    monkeypatch.setattr(hbm, "hbm_bytes_limit", lambda: est * 100)
+    bst2 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert not isinstance(bst2.engine, StreamingGBDT)
+
+
+# ---------------------------------------------------------------------------
+# real multi-process gang (capability-gated like the other gangs)
+# ---------------------------------------------------------------------------
+def _stream_shard_fn(rank, nproc):
+    """Module-level so spawned workers can unpickle it."""
+    X, y = _data(n=4_000, f=6, seed=5)
+    blk = len(X) // nproc
+    lo = rank * blk
+    hi = len(X) if rank == nproc - 1 else lo + blk
+    return {"data": X[lo:hi], "label": y[lo:hi]}
+
+
+def test_streaming_two_process_gang(multiprocess_collectives,
+                                    tmp_path):
+    """2 real processes, each streaming its own shard's blocks, one
+    collective per level: the gang's model must equal the 1-process
+    streamed run on the same rows (bin mappers synced from the union
+    sample on both sides via train_distributed)."""
+    params = {"objective": "binary", "num_leaves": 8, "max_depth": 3,
+              "verbosity": -1, "min_data_in_leaf": 10,
+              "tpu_streaming": "true", "tpu_stream_block_rows": 512,
+              "use_quantized_grad": True}
+    ref = lgb.train_distributed(params, _stream_shard_fn,
+                                n_processes=1, num_boost_round=4,
+                                timeout=240.0)
+    gang = lgb.train_distributed(params, _stream_shard_fn,
+                                 n_processes=2, num_boost_round=4,
+                                 timeout=240.0)
+    assert gang.num_trees() == 4
+    assert gang.model_to_string() == ref.model_to_string()
