@@ -1,0 +1,281 @@
+"""Chaos scenario benchmark: continuous training under injected
+faults — train -> checkpoint publish -> hot-swap -> serve, N cycles,
+while the chaos harness (tpu_fault_inject) interrupts trainers and
+corrupts publishes. Reports the two SLO-shaped numbers ROADMAP item 5
+asks for: MODEL-FRESHNESS LAG (publish -> serving the new model) and
+PREDICT p50/p99 across the swaps, plus dropped-request and staleness
+accounting (docs/robustness.md "Chaos harness").
+
+Run:
+  python benchmarks/chaos_bench.py                     # 5 cycles
+  python benchmarks/chaos_bench.py --cycles 8 --rows 50000
+  python benchmarks/chaos_bench.py --gang              # + a true
+                                                       # SIGKILL gang
+                                                       # cycle
+  python benchmarks/chaos_bench.py --smoke             # CI gate:
+    streamed kill+resume bit-equality + hot-swap under corruption;
+    exit 0 iff every invariant held (scripts/check.sh appends the
+    result as chaos_smoke= on the obs line; scripts/obs_trend.py
+    fails ABSOLUTELY on chaos_smoke=0)
+
+Each line is one JSON record; the final line aggregates.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _data(n, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _corrupt_newest(pub_dir):
+    """What the harness's corrupt fault does, driver-side: damage the
+    newest rank-0 checkpoint payload and clobber the pointer."""
+    from lightgbm_tpu.recovery.checkpoint import CheckpointManager
+    mgr = CheckpointManager(pub_dir, rank=0)
+    its = mgr.iterations()
+    if not its:
+        return
+    p = mgr.path(its[-1])
+    blob = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(blob[:-64] + bytes(64))
+    with open(mgr.latest_pointer, "w") as f:
+        f.write("ckpt_99999999.rank0.ckpt\n")
+
+
+# ---------------------------------------------------------------------------
+# full scenario
+# ---------------------------------------------------------------------------
+def run_cycles(args):
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    X, y = _data(args.rows, seed=0)
+    Xq = X[:args.batch]
+    pub = tempfile.mkdtemp(prefix="lgbm_chaos_pub_")
+    base = {"objective": "binary", "num_leaves": args.leaves,
+            "verbosity": -1}
+    server = lgb.train(base, lgb.Dataset(X, label=y),
+                       num_boost_round=args.rounds)
+    server.watch_checkpoints(pub, interval=0.05)
+    server.predict(Xq)                       # warm the padded shapes
+    lat, lags, dropped, stale_cycles, records = [], [], 0, 0, []
+    for cycle in range(args.cycles):
+        corrupt = cycle % 3 == 2             # every third publish torn
+        interrupted = cycle % 3 == 1         # every third trainer dies
+        Xc, yc = _data(args.rows, seed=100 + cycle)
+        p = dict(base, checkpoint_dir=pub,
+                 checkpoint_interval=max(args.rounds // 2, 1),
+                 seed=100 + cycle)
+        if interrupted:
+            # the chaos harness kills the trainer mid-run; the retry
+            # resumes from the round-boundary checkpoint (bit-exact)
+            p["tpu_fault_inject"] = f"exn:iter={args.rounds - 2}"
+            try:
+                lgb.train(p, lgb.Dataset(Xc, label=yc),
+                          num_boost_round=args.rounds)
+            except lgb.LightGBMError:
+                pass
+            lgb.train(p, lgb.Dataset(Xc, label=yc),
+                      num_boost_round=args.rounds, resume_from=pub)
+        else:
+            lgb.train(p, lgb.Dataset(Xc, label=yc),
+                      num_boost_round=args.rounds)
+        published = time.time()
+        if corrupt:
+            _corrupt_newest(pub)
+        swaps_before = server._model_watch.swaps
+        swap_lag = None
+        for _ in range(args.requests):
+            t0 = time.perf_counter()
+            try:
+                server.predict(Xq)
+            except Exception:
+                dropped += 1
+            lat.append(time.perf_counter() - t0)
+            if swap_lag is None \
+                    and server._model_watch.swaps > swaps_before:
+                swap_lag = time.time() - published
+            time.sleep(args.think)
+        stale = server._model_watch.stale
+        stale_cycles += bool(stale)
+        if swap_lag is not None:
+            lags.append(swap_lag)
+        rec = {"cycle": cycle, "corrupt_publish": corrupt,
+               "trainer_interrupted": interrupted,
+               "swapped": swap_lag is not None,
+               "freshness_lag_s": (round(swap_lag, 3)
+                                   if swap_lag is not None else None),
+               "serving_stale": stale}
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    if args.gang:
+        records.append(run_gang_cycle(args, pub, server, Xq, lat))
+    lat_ms = np.asarray(lat) * 1e3
+    agg = {
+        "aggregate": True, "cycles": args.cycles,
+        "swaps": server._model_watch.swaps,
+        "swap_failures": server._model_watch.failures,
+        "dropped_requests": dropped,
+        "stale_cycles": stale_cycles,
+        "predict_p50_ms": round(float(np.quantile(lat_ms, 0.5)), 3),
+        "predict_p99_ms": round(float(np.quantile(lat_ms, 0.99)), 3),
+        "freshness_lag_p50_s": (round(float(np.median(lags)), 3)
+                                if lags else None),
+        "freshness_lag_max_s": (round(float(np.max(lags)), 3)
+                                if lags else None),
+    }
+    print(json.dumps(agg), flush=True)
+    return 0 if dropped == 0 else 1
+
+
+def run_gang_cycle(args, pub, server, Xq, lat):
+    """One TRUE-SIGKILL cycle: a 1-process train_distributed gang with
+    an injected kill self-heals (watchdog/backoff path) and publishes;
+    the server swaps its model like any other cycle."""
+    import lightgbm_tpu as lgb
+    t0 = time.time()
+    lgb.train_distributed(
+        {"objective": "binary", "num_leaves": args.leaves,
+         "verbosity": -1, "checkpoint_dir": pub,
+         "checkpoint_interval": max(args.rounds // 2, 1),
+         "tpu_fault_inject": f"kill:rank=0,iter={args.rounds - 2}"},
+        _gang_shard_fn, n_processes=1, num_boost_round=args.rounds,
+        timeout=120.0, max_restarts=2, restart_backoff=0.2,
+        heartbeat_timeout=30.0)
+    published = time.time()
+    for _ in range(args.requests):
+        t = time.perf_counter()
+        server.predict(Xq)
+        lat.append(time.perf_counter() - t)
+    rec = {"cycle": "gang-kill", "train_s": round(published - t0, 1),
+           "swapped": True, "serving_stale": server._model_watch.stale}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _gang_shard_fn(rank, nproc):
+    X, y = _data(8_000, seed=7)
+    blk = len(X) // nproc
+    lo = rank * blk
+    hi = len(X) if rank == nproc - 1 else lo + blk
+    return {"data": X[lo:hi], "label": y[lo:hi]}
+
+
+# ---------------------------------------------------------------------------
+# CI smoke: the whole loop, fast, with hard assertions
+# ---------------------------------------------------------------------------
+def run_smoke():
+    """Kill + resume + swap in under a minute, exit nonzero on ANY
+    broken invariant:
+
+    1. a STREAMED run interrupted by the chaos harness and resumed
+       from its round-boundary checkpoint is BIT-IDENTICAL to the
+       uninterrupted run;
+    2. a warm server hot-swaps the published model with zero dropped
+       requests and zero warm-path recompiles (CompileWatch);
+    3. a corrupted publish keeps the previous model serving and flips
+       serve.model_stale.
+
+    (The true-SIGKILL + watchdog variants live in tests/test_chaos.py
+    gang tests; this smoke stays in-process for speed.)
+    """
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.utils.debug import CompileWatch
+    t0 = time.time()
+    X, y = _data(6_000, seed=1)
+    base = {"objective": "binary", "num_leaves": 8, "max_depth": 3,
+            "verbosity": -1, "tpu_streaming": "true",
+            "tpu_stream_block_rows": 2_048, "checkpoint_interval": 2}
+    d1 = tempfile.mkdtemp(prefix="lgbm_chaos_a_")
+    pub = tempfile.mkdtemp(prefix="lgbm_chaos_pub_")
+    straight = lgb.train(dict(base, checkpoint_dir=d1),
+                         lgb.Dataset(X, label=y), num_boost_round=6)
+    chaos = dict(base, checkpoint_dir=pub,
+                 tpu_fault_inject="exn:iter=4")
+    try:
+        lgb.train(chaos, lgb.Dataset(X, label=y), num_boost_round=6)
+        raise AssertionError("injected fault never fired")
+    except lgb.LightGBMError:
+        pass
+    resumed = lgb.train(chaos, lgb.Dataset(X, label=y),
+                        num_boost_round=6, resume_from=pub)
+    assert resumed.model_to_string() == straight.model_to_string(), \
+        "streamed kill+resume lost bit-equality with the straight run"
+
+    # hot-swap: a warm resident server adopts the streamed publish
+    server = lgb.train({"objective": "binary", "num_leaves": 8,
+                        "max_depth": 3, "verbosity": -1},
+                       lgb.Dataset(X, label=y), num_boost_round=6)
+    server.watch_checkpoints(pub, interval=0.0)
+    Xq = X[:512]
+    server.predict(Xq)
+    server.predict(Xq)                      # warm
+    with CompileWatch("chaos-swap") as w:
+        p_swapped = server.predict(Xq)
+    w.assert_compiles(0)
+    assert server._model_watch.swaps == 1, "hot-swap never happened"
+    np.testing.assert_allclose(p_swapped, resumed.predict(Xq),
+                               rtol=1e-5, atol=1e-6)
+    _corrupt_newest(pub)
+    server._model_watch._last_sig = None
+    with CompileWatch("chaos-degrade") as w2:
+        p_stale = server.predict(Xq)
+    w2.assert_compiles(0)
+    np.testing.assert_allclose(p_stale, p_swapped)
+    assert server._model_watch.stale, "corrupt publish not flagged"
+    g = obs.registry().get("serve.model_stale")
+    assert g is not None and g.value == 1.0
+    print(json.dumps({
+        "chaos_smoke": 1, "secs": round(time.time() - t0, 1),
+        "resume_bit_exact": True, "swap_compiles": w.compiles,
+        "stale_flagged": True}), flush=True)
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--cycles", type=int, default=5)
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--leaves", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=512,
+                    help="rows per predict request")
+    ap.add_argument("--requests", type=int, default=20,
+                    help="predict requests per cycle")
+    ap.add_argument("--think", type=float, default=0.0,
+                    help="sleep between requests (s)")
+    ap.add_argument("--gang", action="store_true",
+                    help="add a true-SIGKILL train_distributed cycle")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CI gate (see run_smoke)")
+    args = ap.parse_args()
+    if args.smoke:
+        return run_smoke()
+    return run_cycles(args)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:
+        import traceback
+        traceback.print_exc()
+        print(json.dumps({"chaos_smoke": 0,
+                          "error": f"{type(e).__name__}: {e}"}),
+              flush=True)
+        sys.exit(1)

@@ -23,6 +23,7 @@ __all__ = [
     "early_stopping", "log_evaluation", "record_evaluation",
     "record_metrics", "reset_parameter", "EarlyStopException",
     "checkpoint", "CheckpointManager", "CheckpointError", "obs",
+    "ModelWatcher",
 ]
 
 
@@ -48,6 +49,9 @@ def __getattr__(name):
         if name in ("CheckpointManager", "CheckpointError"):
             from .recovery import checkpoint as _ck
             return getattr(_ck, name)
+        if name == "ModelWatcher":
+            from . import serving as _sv
+            return _sv.ModelWatcher
     except ImportError as e:
         raise AttributeError(
             f"module 'lightgbm_tpu' has no attribute {name!r}: {e}") from e

@@ -66,6 +66,34 @@ class Booster:
         else:
             raise TypeError("At least one of train_set, model_file or "
                             "model_str should be provided")
+        # serve-side hot-swap (serving.py): tpu_model_watch names a
+        # checkpoint dir this Booster polls at predict time, atomically
+        # swapping freshly published models in
+        self._model_watch = None
+        watch = str(getattr(self.config, "tpu_model_watch", "")
+                    or "").strip()
+        if watch:
+            self.watch_checkpoints(
+                watch, interval=float(getattr(
+                    self.config, "tpu_model_watch_interval", 2.0)))
+
+    def watch_checkpoints(self, directory: str,
+                          interval: float = 2.0) -> "Booster":
+        """Hot-swap serving: poll ``directory`` (a recovery-subsystem
+        checkpoint dir) every ``interval`` seconds at predict time and
+        atomically adopt the newest valid checkpoint's model — zero
+        dropped requests, zero warm-path recompiles for same-bucket
+        models, graceful degradation on corrupt publishes
+        (docs/robustness.md "Hot-swap serving"). The param form is
+        ``tpu_model_watch`` / ``tpu_model_watch_interval``."""
+        from .serving import ModelWatcher
+        self._model_watch = ModelWatcher(directory, interval=interval)
+        if self._engine is not None:
+            # pin the engine to bucketed predict shapes up front so the
+            # warm-up predict compiles the SAME programs every later
+            # swap reuses (not an unpadded one-off)
+            self._engine._stable_predict_shapes = True
+        return self
 
     # ------------------------------------------------------------------
     @property
@@ -180,6 +208,11 @@ class Booster:
                 num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **_kwargs) -> np.ndarray:
+        if getattr(self, "_model_watch", None) is not None:
+            # serve-side hot-swap: rate-limited poll of the watched
+            # checkpoint dir; runs on THIS thread before the model is
+            # read, so the request sees old or new atomically
+            self._model_watch.maybe_swap(self)
         if num_iteration is None:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)

@@ -2670,8 +2670,18 @@ class GBDT:
         tree to the config leaf cap, so each distinct slice length
         reuses a bucketed traversal compile instead of triggering a
         fresh one (the same ``pad_count``/``pad_leaves`` knobs DART's
-        drop stacks use)."""
-        if start_tree == 0 and n_trees == len(self.models):
+        drop stacks use).
+
+        ``_stable_predict_shapes`` (set by serving.ModelWatcher when
+        this engine serves under a checkpoint watch) extends the
+        bucketed padding to the FULL forest too: successive hot-swapped
+        models whose actual max leaf counts differ would otherwise
+        stack to different shapes and recompile the warm path on every
+        swap — padded to (pow2 tree count, config num_leaves), every
+        swap in the same bucket reuses the compiled programs
+        (CompileWatch-pinned in tests/test_chaos.py)."""
+        if (not getattr(self, "_stable_predict_shapes", False)
+                and start_tree == 0 and n_trees == len(self.models)):
             return self._stack_model_list(list(range(n_trees)),
                                           use_cache=use_cache)
         return self._stack_model_list(

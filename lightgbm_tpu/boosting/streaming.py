@@ -60,6 +60,12 @@ epochs"; §3.4 data-parallel learner for the sharded composition):
   bit-identical-across-shards guarantee) and engage the packed int32
   wire (2/3 payload) on the per-level collective.
 
+Durable checkpoints / resume: the engine exports and imports complete
+training state through the recovery subsystem (export_train_state /
+import_train_state below) — a streamed, even sharded, run interrupted
+mid-training resumes BIT-EXACT from its newest round-boundary
+checkpoint (docs/robustness.md "Streamed (out-of-core) resume").
+
 Supported configs (all checked at construction): single-output
 objectives (binary, regression family, xentropy) on numerical
 features, tree_learner serial or data, bagging (incl. pos/neg
@@ -216,6 +222,10 @@ class StreamingGBDT:
         self.num_class = 1
         self.average_output = False
         self.models: List[Tree] = []
+        # mutation version for host-model / hot-swap cache keys (the
+        # resident engine's _invalidate_forest_cache analog; bumped by
+        # serving.ModelWatcher when it swaps a new forest in)
+        self._models_version = 0
         self.iter_ = 0
         self.valid_data: list = []
         self.valid_names: list = []
@@ -988,8 +998,14 @@ class StreamingGBDT:
         log.fatal(self._UNSUPPORTED_MSG.format(what="rollback"))
 
     def train_chunk(self, k: int):
+        from .. import obs
         for _ in range(k):
             self.train_one_iter()
+            # liveness on the fused (no-callback) path: the engine.py
+            # round loop is bypassed here, so the watchdog's heartbeat
+            # must ride the chunk loop itself (gbdt.train_chunk stamps
+            # the same way)
+            obs.heartbeat("train")
 
     # -------------------------------------------------------- training
     def _pad_block(self, arr, lo, hi, fill=0):
@@ -1278,6 +1294,143 @@ class StreamingGBDT:
             tree_arrays, self.lr, self.train_set.bin_mappers,
             list(self.train_set.used_features)))
         self.iter_ += 1
+
+    # ------------------------------------------ checkpoint / resume
+    # The streamed engine is the one training path where preemption is
+    # the NORM (out-of-core runs are the longest runs), so it carries
+    # the same durable-checkpoint contract as the resident engine:
+    # export everything that evolves across rounds, and a resumed run
+    # is bit-exact vs an uninterrupted one BY CONSTRUCTION — the
+    # bagging/GOSS/stochastic-rounding draws are counter-hashes of the
+    # GLOBAL row index + per-round salts derived from (seed, iter), so
+    # they need no saved state; what must travel is the device-resident
+    # scores, the host RNG (feature_fraction / extra_trees draws), the
+    # pending next-round statistics the last final sweep folded out
+    # (saving them beats recomputing: a standalone stats prepass could
+    # fuse differently under XLA than the folded one), and the shard/
+    # block layout the scores are cut by.
+    def _layout_fingerprint(self) -> Dict:
+        return {
+            "R": int(self.R),
+            "n": int(self.n),
+            "n_global": int(self.n_global),
+            "block_rows": int(self.block_rows),
+            "ranks": [(int(rk["pos"]), int(rk["lo"]), int(rk["hi"]),
+                       int(rk["goff"]), int(rk["n_blocks"]))
+                      for rk in self._ranks],
+        }
+
+    def export_train_state(self) -> Dict:
+        state = {
+            "engine": type(self).__name__,
+            "iteration": int(self.iter_),
+            # exact pickled trees (model TEXT rounds values through
+            # "{:g}" — not bit-exact), same as the resident engine
+            "models": list(self.models),
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+            "init_scores": self.init_scores.copy(),
+            "rng": self._rng.bit_generator.state,
+            "layout": self._layout_fingerprint(),
+            # the device-resident per-(rank, block) score slots — THE
+            # accumulated floats a resumed run must continue from
+            # (padded to block_rows; the pad lanes are inert)
+            "scores": [[np.asarray(s) for s in per_rank]
+                       for per_rank in self._score_dev],
+            # next round's GOSS/quantization statistics, folded out of
+            # the final sweep that just ran (None when untracked or
+            # already consumed — a standalone prepass recomputes then)
+            "pending_stats": (
+                None if self._pending_stats is None else
+                [(np.asarray(m), np.asarray(c))
+                 for (m, c) in self._pending_stats]),
+            # incremental valid-set raw caches (host f64 accumulators;
+            # rebuilding them from scratch re-sums trees in a different
+            # association order — not bit-identical)
+            "valid_raw_cache": {int(k): (int(done), raw.copy())
+                                for k, (done, raw)
+                                in self._valid_raw_cache.items()},
+        }
+        return state
+
+    def import_train_state(self, state: Dict) -> bool:
+        """Adopt :meth:`export_train_state` output into a freshly
+        constructed engine. Unlike the resident engine there is no
+        best-effort score-rebuild fallback: streamed scores can only be
+        rebuilt by re-streaming every block through the forest, so a
+        changed shard/block layout is a hard error naming what moved —
+        resume with the same data, mesh and block size (or drop
+        ``resume_from``). Returns True (always bit-exact)."""
+        saved_engine = state.get("engine")
+        if saved_engine is not None \
+                and saved_engine != type(self).__name__:
+            log.fatal(
+                f"checkpoint was written by a {saved_engine} engine but "
+                f"resume constructed {type(self).__name__} — the "
+                f"boosting/tree_learner/tpu_streaming params must match "
+                f"the original run")
+        models = state.get("models")
+        if models is None:
+            log.fatal("checkpoint state holds no model trees — corrupt "
+                      "or incompatible checkpoint")
+        saved_layout = state.get("layout") or {}
+        layout = self._layout_fingerprint()
+        if saved_layout != layout:
+            diff = [k for k in layout
+                    if saved_layout.get(k) != layout[k]]
+            log.fatal(
+                f"streamed resume requires the identical shard/block "
+                f"layout the checkpoint was written under; "
+                f"{', '.join(diff) or 'layout'} changed "
+                f"(saved { {k: saved_layout.get(k) for k in diff} }, "
+                f"now { {k: layout[k] for k in diff} }) — rerun with "
+                f"the same rows, tpu_mesh_shape and "
+                f"tpu_stream_block_rows, or start fresh")
+        if int(state.get("process_count", 1)) != jax.process_count() \
+                or int(state.get("process_index", 0)) \
+                != jax.process_index():
+            log.fatal(
+                f"streamed checkpoint was written by rank "
+                f"{state.get('process_index')} of "
+                f"{state.get('process_count')} but this process is "
+                f"rank {jax.process_index()} of {jax.process_count()} "
+                f"— streamed scores are per-process shards and cannot "
+                f"be re-cut")
+        self.models = list(models)
+        self._models_version += 1
+        self.iter_ = int(state["iteration"])
+        if len(self.models) != self.iter_:
+            log.fatal(
+                f"checkpoint state is for iteration "
+                f"{state['iteration']} but holds {len(self.models)} "
+                f"trees — mismatched checkpoint contents")
+        if state.get("init_scores") is not None:
+            self.init_scores = np.asarray(state["init_scores"],
+                                          np.float64)
+        self._rng.bit_generator.state = state["rng"]
+        scores = state["scores"]
+        for ri, rk in enumerate(self._ranks):
+            for b in range(rk["n_blocks"]):
+                self._score_dev[ri][b] = self._put(
+                    np.asarray(scores[ri][b], np.float32), rk["dev"])
+            # leaf slots are per-tree transients (reset at every round
+            # start); point them back at the shared zero block
+            for b in range(rk["n_blocks"]):
+                self._leaf_dev[ri][b] = self._zeros_leaf[ri]
+        pend = state.get("pending_stats")
+        if pend is not None and self._track_stats:
+            self._pending_stats = [
+                (self._put(np.asarray(m, np.float32), rk["dev"]),
+                 self._put(np.asarray(c, np.int32), rk["dev"]))
+                for (m, c), rk in zip(pend, self._ranks)]
+        else:
+            self._pending_stats = None
+        self._valid_raw_cache = {
+            int(k): (int(done), np.asarray(raw, np.float64))
+            for k, (done, raw)
+            in (state.get("valid_raw_cache") or {}).items()}
+        self._hm_cache = (None, None)
+        return True
 
     # ------------------------------------------------------- predict
     def predict(self, X, raw_score: bool = False,

@@ -242,9 +242,9 @@ def checkpoint(checkpoint_dir: str, interval: int = 1, keep_n: int = 3,
             if not warned[0]:
                 warned[0] = True
                 log.warning(
-                    "callback.checkpoint: the model has no resident "
-                    "GBDT engine (cv boosters and the streaming engine "
-                    "are not checkpointable); skipping checkpoint saves")
+                    "callback.checkpoint: the model has no "
+                    "checkpointable training engine (cv boosters are "
+                    "not checkpointable); skipping checkpoint saves")
             return
         cb_states: Dict[str, Any] = {}
         for cb in peers:

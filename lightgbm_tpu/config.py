@@ -260,6 +260,24 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # marker directory for fault fire-once bookkeeping (defaults to
     # checkpoint_dir when unset)
     "tpu_fault_marker": _P("str", ""),
+    # watchdog liveness: when set, the training round loop stamps a
+    # per-rank heartbeat FILE (heartbeat.train.rank<r>) under this dir
+    # (mtime = liveness; throttled to ~1 Hz). train_distributed sets it
+    # on every worker when a heartbeat timeout is configured and KILLS
+    # + relaunches a gang whose stamp goes stale past
+    # tpu_heartbeat_timeout — a hung rank becomes the already-handled
+    # crash case instead of wedging forever (docs/robustness.md)
+    "tpu_heartbeat_dir": _P("str", ""),
+    # serve-side hot-swap: a checkpoint DIRECTORY this Booster watches;
+    # each predict polls the `latest` checkpoint pointer (throttled to
+    # tpu_model_watch_interval seconds) and atomically swaps the new
+    # model in — warm in-engine tree adoption (zero dropped requests,
+    # zero recompiles under stable shapes), host-model fallback
+    # otherwise. A corrupt/half-written checkpoint keeps the previous
+    # model serving and flips the serve.model_stale gauge
+    # (docs/robustness.md "Hot-swap serving")
+    "tpu_model_watch": _P("str", ""),
+    "tpu_model_watch_interval": _P("float", 2.0, [], (0.0, None)),
     # ---- TPU-specific (new; no reference analog) -------------------------
     "tpu_rows_per_block": _P("int", 4096),
     "tpu_mesh_shape": _P("str", ""),

@@ -127,18 +127,55 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     f"cleared for this fresh run")
         callbacks.append(ckpt_cb)
     if str(cfg.tpu_fault_inject).strip():
-        from .recovery.faults import fault_injection_callback
+        import os as _os
+
+        from .recovery.faults import (_current_rank, clear_fault_markers,
+                                      fault_injection_callback)
+        marker_dir = cfg.tpu_fault_marker or cfg.checkpoint_dir
+        if resume_from is None and marker_dir \
+                and not _os.environ.get("LGBM_TPU_GANG_RELAUNCH"):
+            # fresh (non-resume) run claiming the marker dir clears
+            # THIS rank's stale fire-once markers (mirrors the
+            # checkpoint clear_rank_files above) — yesterday's marker
+            # must not suppress today's injected fault. Gated on the
+            # resume_from ARGUMENT (the user's intent), not on whether
+            # a valid checkpoint exists yet: a supervisor re-running
+            # train(resume_from=dir) after a fault that fired BEFORE
+            # the first checkpoint gets resume_state None, and clearing
+            # then would delete the marker the dying attempt just wrote
+            # — an infinite kill loop. Gang RELAUNCHES are exempt too
+            # (LGBM_TPU_GANG_RELAUNCH, set by the launcher, which owns
+            # marker hygiene driver-side)
+            cleared = clear_fault_markers(marker_dir,
+                                          rank=_current_rank())
+            if cleared:
+                log.warning(
+                    f"tpu_fault_inject: cleared {cleared} stale "
+                    f"fire-once marker(s) from {marker_dir} for this "
+                    f"fresh run")
         callbacks.append(fault_injection_callback(
-            cfg.tpu_fault_inject,
-            marker_dir=(cfg.tpu_fault_marker or cfg.checkpoint_dir)))
+            cfg.tpu_fault_inject, marker_dir=marker_dir,
+            ckpt_dir=cfg.checkpoint_dir))
+
+    # launcher watchdog liveness: stamp a per-rank heartbeat FILE the
+    # driver can see (obs gauges are process-local); created on the
+    # first round's stamp so startup compiles don't read as stale
+    hb_dir = str(getattr(cfg, "tpu_heartbeat_dir", "") or "").strip()
+    if hb_dir:
+        import os as _os
+
+        from .recovery.faults import _current_rank
+        obs.set_heartbeat_file(
+            "train",
+            _os.path.join(hb_dir,
+                          f"heartbeat.train.rank{_current_rank()}"))
 
     start_iter = 0
     if resume_state is not None:
         eng = booster.engine
         if not hasattr(eng, "import_train_state"):
-            log.fatal("resume_from requires the resident GBDT engine "
-                      "(the streaming engine does not checkpoint); set "
-                      "tpu_streaming=false or drop resume_from")
+            log.fatal(f"resume_from is not supported by the "
+                      f"{type(eng).__name__} engine")
         eng.import_train_state(resume_state["engine"])
         bstate = resume_state.get("booster") or {}
         booster.best_iteration = int(bstate.get("best_iteration", -1))

@@ -33,6 +33,7 @@ benches, the utils/timer back-compat shim) pass ``force=True``.
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -47,6 +48,7 @@ __all__ = [
     "enable", "disable", "enabled", "any_enabled", "tracing_enabled",
     "slo_enabled", "span", "inc", "set_gauge", "observe", "counter",
     "gauge", "histogram", "heartbeat", "retire_heartbeat",
+    "set_heartbeat_file",
     "predict_instrumented", "registry", "snapshot", "dump_jsonl",
     "prometheus_text", "prometheus_from_snapshot",
     "export_chrome_trace", "export_state", "import_state", "reset",
@@ -208,15 +210,54 @@ def observe(name: str, value: float, force: bool = False,
             _slo.feed_hist(name, value)
 
 
+# cross-process heartbeat FILE sinks: kind -> [path, min_interval_s,
+# last_stamp_monotonic]. The obs gauges above are process-local; the
+# distributed launcher's watchdog lives in ANOTHER process, so workers
+# stamp a file (mtime = the heartbeat) it can stat. Registered by
+# engine.train from ``tpu_heartbeat_dir``; stamping is throttled to
+# min_interval so a sub-millisecond round loop costs one clock read,
+# not one syscall, per round. The file is created lazily on the FIRST
+# stamp — a worker still compiling has no file, which the watchdog
+# reads as "starting up" (covered by the gang timeout), never "stale".
+_HB_FILES: Dict[str, list] = {}
+
+
+def set_heartbeat_file(kind: str, path: Optional[str],
+                       min_interval: float = 1.0) -> None:
+    """Register (or, with ``path=None``, drop) a heartbeat file for
+    ``kind``: every :func:`heartbeat` call refreshes the file's mtime
+    (rate-limited to ``min_interval`` seconds). Works with the metrics
+    pillar OFF — watchdog liveness must not depend on the user opting
+    into metrics."""
+    if path is None:
+        _HB_FILES.pop(kind, None)
+        return
+    _HB_FILES[kind] = [str(path), float(min_interval), 0.0]
+
+
 def heartbeat(kind: str) -> None:
     """Stamp the ``heartbeat.<kind>`` gauge with the current monotonic
     time. The round loop stamps ``train``, the predict path ``serve``;
     /healthz and /readyz (obs/server.py) compare these stamps against
-    the staleness timeout. One gauge set when metrics are on, a single
-    bool check when off — heartbeat call sites ride the hot loops."""
+    the staleness timeout, and the launcher watchdog compares the
+    registered heartbeat FILE's mtime (:func:`set_heartbeat_file`).
+    One gauge set when metrics are on, a single bool check when off —
+    heartbeat call sites ride the hot loops."""
     if _state.metrics:
         _metrics.registry().gauge(f"heartbeat.{kind}").set(
             time.monotonic())
+    if _HB_FILES:
+        ent = _HB_FILES.get(kind)
+        if ent is not None:
+            now = time.monotonic()
+            if now - ent[2] >= ent[1]:
+                ent[2] = now
+                try:
+                    with open(ent[0], "a"):
+                        pass
+                    os.utime(ent[0])
+                except OSError:
+                    pass
 
 
 def predict_instrumented(call: Callable[[], Any], data) -> Any:
@@ -251,12 +292,20 @@ def retire_heartbeat(kind: str) -> None:
     tracked. A retired heartbeat is *absent* — /healthz stays green
     for a process that finished its work and went idle — while a
     crashed or wedged loop leaves its last stamp behind to go stale
-    (the 503 signal). Serving heartbeats are never retired: a serving
-    process with no traffic for the staleness timeout IS the signal a
-    load balancer probes for."""
+    (the 503 signal). The same contract applies to the heartbeat FILE:
+    a clean finish unlinks it (absent = finished), a wedge leaves it
+    to go stale under the launcher watchdog. Serving heartbeats are
+    never retired: a serving process with no traffic for the staleness
+    timeout IS the signal a load balancer probes for."""
     reg = _metrics.registry()
     if reg.get(f"heartbeat.{kind}") is not None:
         reg.reset(prefix=f"heartbeat.{kind}", kind="gauge")
+    ent = _HB_FILES.pop(kind, None)
+    if ent is not None:
+        try:
+            os.unlink(ent[0])
+        except OSError:
+            pass
 
 
 def counter(name: str, **labels) -> _metrics.Counter:

@@ -40,14 +40,27 @@ RAM x beyond one device's HBM become a worker-count question.
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
+import re
 import socket
 from dataclasses import dataclass
-from typing import Callable, Dict, Optional, Union
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
 from ..utils import log
 from ..utils.log import LightGBMError
+
+# set in the driver's environment (inherited by spawned workers) for
+# EVERY launcher-spawned gang: a worker seeing it skips its fresh-run
+# fault-marker clearing — marker hygiene is driver-owned here (one
+# clear before the first gang, no per-rank race, and a from-scratch
+# relaunch replaying the fault iteration honors the already-fired
+# marker instead of re-dying on it every attempt). Direct lgb.train /
+# run_worker users keep the worker-side clearing.
+_RELAUNCH_ENV = "LGBM_TPU_GANG_RELAUNCH"
+
+_HB_FILE_RE = re.compile(r"^heartbeat\.train\.rank(\d+)$")
 
 
 def _free_port() -> int:
@@ -56,6 +69,51 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _clear_heartbeat_files(hb_dir: Optional[str]) -> None:
+    """Remove per-rank heartbeat stamp files before (re)launching a
+    gang — a stale file from the previous gang would read as an
+    instantly-hung rank and kill every relaunch on sight."""
+    if not hb_dir:
+        return
+    try:
+        names = os.listdir(hb_dir)
+    except OSError:
+        return
+    for name in names:
+        if _HB_FILE_RE.match(name):
+            try:
+                os.unlink(os.path.join(hb_dir, name))
+            except OSError:
+                pass
+
+
+def _stale_heartbeats(hb_dir: Optional[str],
+                      timeout: float) -> List[Tuple[int, float]]:
+    """(rank, age_seconds) for every heartbeat file older than
+    ``timeout``. A rank with NO file yet is starting up (compiling,
+    binning) — that is the overall gang timeout's job, not a hang."""
+    if not hb_dir or timeout <= 0:
+        return []
+    import time as _time
+    try:
+        names = os.listdir(hb_dir)
+    except OSError:
+        return []
+    now = _time.time()
+    stale = []
+    for name in names:
+        m = _HB_FILE_RE.match(name)
+        if not m:
+            continue
+        try:
+            age = now - os.stat(os.path.join(hb_dir, name)).st_mtime
+        except OSError:
+            continue
+        if age > timeout:
+            stale.append((int(m.group(1)), round(age, 1)))
+    return sorted(stale)
 
 
 @dataclass
@@ -214,14 +272,26 @@ def _spawn_main(rank, nproc, port, params, data_fn, num_boost_round,
 
 def _gang_once(params: Dict, data_fn, n_processes: int,
                num_boost_round: int, platform, categorical_feature,
-               timeout: float, resume_from: Optional[str]):
+               timeout: float, resume_from: Optional[str],
+               hb_dir: Optional[str] = None,
+               hb_timeout: float = 0.0):
     """One fork/join pass over a fresh worker gang on a fresh port.
     Returns the ("ok", model_str) / ("err", payload) queue result, or
     None when the gang died or timed out without reporting (plus the
-    dead rank/exitcode list for the error message)."""
+    dead rank/exitcode list for the error message).
+
+    ``hb_dir``/``hb_timeout``: the heartbeat watchdog — workers stamp
+    per-rank heartbeat files each round (engine.train via
+    ``tpu_heartbeat_dir``); a stamp stale past ``hb_timeout`` means a
+    HUNG rank (wedged pre-collective, stuck DMA): the gang is torn
+    down like a crashed one and the caller's restart loop relaunches
+    it. Hangs otherwise wedge forever — no exit code, no queue
+    result — and only the blunt overall ``timeout`` would catch them.
+    """
     ctx = mp.get_context("spawn")     # fork would inherit JAX state
     port = _free_port()
     queue = ctx.Queue()
+    _clear_heartbeat_files(hb_dir)
     procs = [ctx.Process(
         target=_spawn_main,
         args=(r, n_processes, port, params, data_fn, num_boost_round,
@@ -244,6 +314,24 @@ def _gang_once(params: Dict, data_fn, n_processes: int,
             dead = [(i, p.exitcode) for i, p in enumerate(procs)
                     if not p.is_alive() and p.exitcode not in (0, None)]
             if dead:
+                break
+            stale = _stale_heartbeats(hb_dir, hb_timeout)
+            if stale:
+                from .. import obs
+                # forced: the watchdog fires before any Config can
+                # flip metrics on, like the restart counters
+                obs.inc("watchdog.restarts", force=True)
+                log.warning(
+                    f"heartbeat watchdog: rank(s) "
+                    f"{[r for r, _ in stale]} stale for "
+                    f"{[a for _, a in stale]}s "
+                    f"(> {hb_timeout:.1f}s) — killing the gang as "
+                    f"hung")
+                result = ("err",
+                          f"heartbeat watchdog: rank(s) {stale} went "
+                          f"stale past {hb_timeout:.1f}s — presumed "
+                          f"hung pre-collective; gang killed for "
+                          f"relaunch")
                 break
         except Exception as e:
             # a worker killed MID-put leaves a truncated pickle in the
@@ -300,7 +388,8 @@ def train_distributed(params: Dict,
                       restart_backoff: float = 1.0,
                       checkpoint_dir: Optional[str] = None,
                       checkpoint_interval: int = 0,
-                      resume: Union[bool, str] = "auto"):
+                      resume: Union[bool, str] = "auto",
+                      heartbeat_timeout: Optional[float] = None):
     """Train over ``n_processes`` localhost processes and return the
     rank-0 Booster (the dask.py ``_train`` analog).
 
@@ -334,6 +423,15 @@ def train_distributed(params: Dict,
         the job instead of wiping its checkpoints. False forces a fresh
         run (stale checkpoints are cleared); True requires a resumable
         checkpoint and raises when the dir holds none.
+      heartbeat_timeout: heartbeat watchdog (seconds; also readable
+        from params' ``tpu_heartbeat_timeout``). Workers stamp
+        per-rank heartbeat files every round; a stamp stale past this
+        timeout marks the rank HUNG (wedged pre-collective) and the
+        gang is killed and relaunched through the same restart/backoff
+        path a crash takes — so give it restart budget via
+        ``max_restarts``. Set it above the worst cold-compile +
+        per-round time; 0/None disables (hangs then only hit the
+        blunt overall ``timeout``).
     """
     from ..recovery.restart import (backoff_seconds,
                                     has_resumable_checkpoint,
@@ -346,6 +444,31 @@ def train_distributed(params: Dict,
         # params) — the dir may come from params with the cadence here
         params["checkpoint_interval"] = int(checkpoint_interval)
     ckpt_dir = str(params.get("checkpoint_dir") or "") or None
+
+    # heartbeat watchdog wiring: give every worker a stamp-file dir and
+    # remember the staleness budget the poll loop enforces
+    hb_timeout = (float(heartbeat_timeout)
+                  if heartbeat_timeout is not None
+                  else float(params.get("tpu_heartbeat_timeout", 0)
+                             or 0))
+    if 0 < hb_timeout < 3.0:
+        # workers stamp at most ~1 Hz (obs.set_heartbeat_file's
+        # throttle): a timeout at or below the stamp interval would
+        # read every HEALTHY rank as hung and kill each gang right
+        # after its first stamp
+        log.warning(f"heartbeat_timeout={hb_timeout:g}s is below the "
+                    f"~1 Hz stamp cadence; raising to 3s")
+        hb_timeout = 3.0
+    hb_dir = str(params.get("tpu_heartbeat_dir") or "").strip() or None
+    if hb_timeout > 0 and not hb_dir:
+        if ckpt_dir:
+            hb_dir = ckpt_dir
+        else:
+            import tempfile
+            hb_dir = tempfile.mkdtemp(prefix="lgbm_tpu_hb_")
+    if hb_timeout > 0:
+        params["tpu_heartbeat_dir"] = hb_dir
+        os.makedirs(hb_dir, exist_ok=True)
 
     # cross-driver resume: a preempted/killed DRIVER re-running the
     # same call must continue the job, not clear its checkpoints
@@ -392,63 +515,101 @@ def train_distributed(params: Dict,
                         f"{len(stale)} snapshot file(s) from a "
                         f"previous run; cleared for this fresh run")
 
+    # fault-marker hygiene is DRIVER-owned under the launcher: clear
+    # stale fire-once markers for the whole gang once, before any
+    # worker exists (no per-rank race), and have every worker — first
+    # launch, bind retry, or relaunch alike — keep markers via the
+    # relaunch env var. Worker-side clearing would race a first gang
+    # that never reaches engine.train (a genuine bind-race loss) into
+    # skipping the clear entirely.
+    fi_spec = str(params.get("tpu_fault_inject") or "").strip()
+    fault_marker_dir = (str(params.get("tpu_fault_marker") or "")
+                        or ckpt_dir)
+    if fi_spec and fault_marker_dir and resume_from is None:
+        from ..recovery.faults import clear_fault_markers
+        cleared = clear_fault_markers(fault_marker_dir)
+        if cleared:
+            log.warning(f"tpu_fault_inject: cleared {cleared} stale "
+                        f"fire-once marker(s) from {fault_marker_dir} "
+                        f"for this fresh run")
+
+    import random as _random
+
+    # decorrelated-jitter state for the restart backoff: N drivers (or
+    # gang re-runs) sleeping IDENTICAL exponential delays would
+    # stampede the coordinator port in lockstep every attempt — the
+    # bind-retry counter below measures exactly those collisions
+    _backoff_rng = _random.Random()
+    _backoff_prev = 0.0
     attempt = 0           # restart attempts consumed (not bind retries)
-    while True:
-        result = None
-        # the coordinator port race (_free_port -> jax.distributed
-        # bind) loses when another process grabs the probed port first;
-        # a bind failure retries on a fresh port WITHOUT consuming a
-        # restart attempt
-        for bind_attempt in range(3):
-            result, dead = _gang_once(
-                params, data_fn, n_processes, num_boost_round, platform,
-                categorical_feature, timeout, resume_from)
-            if (result is not None and result[0] == "err"
-                    and is_bind_failure(result[1]) and bind_attempt < 2):
-                from .. import obs
-                obs.inc("restart.bind_retries", force=True)
-                log.warning(
-                    "coordinator port was reclaimed before bind "
-                    "(the _free_port race); relaunching the worker "
-                    "gang on a fresh port")
-                continue
-            break
-        if result is not None and result[0] == "ok":
-            bst_str = result[1]
-            break
-        if result is not None:
-            failure = LightGBMError(
-                f"distributed worker failed: {result[1]}")
-        else:
-            failure = LightGBMError(
-                "distributed training produced no result "
-                + (f"(worker ranks/exitcodes {dead} died — is data_fn "
-                   f"a module-level importable callable? spawn "
-                   f"re-imports its module in each worker)" if dead else
-                   "(workers timed out before rank 0 reported; re-run "
-                   "with verbosity>=1 for worker logs)"))
-        attempt += 1
-        if attempt > max_restarts:
-            raise failure
-        resume_from = (ckpt_dir if ckpt_dir
-                       and has_resumable_checkpoint(ckpt_dir) else None)
-        # forced: gang restarts are exactly the restart-loop signal the
-        # obs subsystem exists to surface, and the launcher runs before
-        # any Config can flip tpu_metrics on
-        from .. import obs
-        obs.inc("restart.attempts", force=True)
-        if resume_from:
-            obs.inc("restart.resumes", force=True)
-        delay = backoff_seconds(attempt, restart_backoff)
-        log.warning(
-            f"distributed training attempt {attempt} of "
-            f"{max_restarts + 1} failed ({failure}); "
-            + (f"resuming every rank from the newest checkpoint in "
-               f"{resume_from} " if resume_from else
-               "no resumable checkpoint — restarting from scratch ")
-            + f"on a fresh port after {delay:.1f}s backoff")
-        import time as _time
-        _time.sleep(delay)
+    try:
+        os.environ[_RELAUNCH_ENV] = "1"
+        while True:
+            result = None
+            # the coordinator port race (_free_port -> jax.distributed
+            # bind) loses when another process grabs the probed port
+            # first; a bind failure retries on a fresh port WITHOUT
+            # consuming a restart attempt
+            for bind_attempt in range(3):
+                result, dead = _gang_once(
+                    params, data_fn, n_processes, num_boost_round,
+                    platform, categorical_feature, timeout, resume_from,
+                    hb_dir=hb_dir if hb_timeout > 0 else None,
+                    hb_timeout=hb_timeout)
+                if (result is not None and result[0] == "err"
+                        and is_bind_failure(result[1])
+                        and bind_attempt < 2):
+                    from .. import obs
+                    obs.inc("restart.bind_retries", force=True)
+                    log.warning(
+                        "coordinator port was reclaimed before bind "
+                        "(the _free_port race); relaunching the worker "
+                        "gang on a fresh port")
+                    continue
+                break
+            if result is not None and result[0] == "ok":
+                bst_str = result[1]
+                break
+            if result is not None:
+                failure = LightGBMError(
+                    f"distributed worker failed: {result[1]}")
+            else:
+                failure = LightGBMError(
+                    "distributed training produced no result "
+                    + (f"(worker ranks/exitcodes {dead} died — is "
+                       f"data_fn a module-level importable callable? "
+                       f"spawn re-imports its module in each worker)"
+                       if dead else
+                       "(workers timed out before rank 0 reported; "
+                       "re-run with verbosity>=1 for worker logs)"))
+            attempt += 1
+            if attempt > max_restarts:
+                raise failure
+            resume_from = (ckpt_dir if ckpt_dir
+                           and has_resumable_checkpoint(ckpt_dir)
+                           else None)
+            # forced: gang restarts are exactly the restart-loop signal
+            # the obs subsystem exists to surface, and the launcher
+            # runs before any Config can flip tpu_metrics on
+            from .. import obs
+            obs.inc("restart.attempts", force=True)
+            if resume_from:
+                obs.inc("restart.resumes", force=True)
+            delay = backoff_seconds(attempt, restart_backoff,
+                                    rng=_backoff_rng,
+                                    prev=_backoff_prev)
+            _backoff_prev = delay
+            log.warning(
+                f"distributed training attempt {attempt} of "
+                f"{max_restarts + 1} failed ({failure}); "
+                + (f"resuming every rank from the newest checkpoint in "
+                   f"{resume_from} " if resume_from else
+                   "no resumable checkpoint — restarting from scratch ")
+                + f"on a fresh port after {delay:.1f}s backoff")
+            import time as _time
+            _time.sleep(delay)
+    finally:
+        os.environ.pop(_RELAUNCH_ENV, None)
 
     # gang-wide metrics view: merge the per-rank snapshots the workers
     # dumped (counters sum, gauges latest, histograms bucket-add) into

@@ -1,35 +1,88 @@
-"""Fault injection for fault-tolerance CI (``tpu_fault_inject``).
+"""Fault injection for fault-tolerance CI (``tpu_fault_inject``) — the
+chaos harness the recovery subsystem is attacked with.
 
-Spec syntax: ``"<kind>:key=value,key=value"`` with kind one of
+Spec syntax: one or more ``;``-separated specs, each
+``"<kind>:key=value,key=value"`` with kind one of
 
-* ``kill`` — SIGKILL this process (simulates preemption / OOM-kill),
-* ``exn``  — raise ``LightGBMError`` (simulates an in-band failure).
+* ``kill``    — SIGKILL this process (preemption / OOM-kill),
+* ``exn``     — raise ``LightGBMError`` (in-band failure),
+* ``hang``    — wedge the process in an uninterruptible-looking sleep
+  BEFORE it reaches the next collective (a stuck DMA / dead peer /
+  deadlocked rank; only the launcher's heartbeat watchdog — or SIGKILL
+  — gets it unstuck),
+* ``slow``    — inject ``ms`` of delay before every matching iteration
+  from ``iter`` on (a straggler rank; shows up in the
+  ``dist.round_time_spread`` gauge, never kills anything),
+* ``corrupt`` — flip bytes in the newest checkpoint in ``dir`` (or
+  clobber its ``latest`` pointer with ``target=latest``, or both with
+  ``target=both``) — a torn write / bad disk the loader must fall back
+  past,
+* ``port``    — raise an error shaped like the coordinator bind race
+  ("address already in use"), so relaunch paths exercise their
+  fresh-port bind retry deterministically.
 
 Keys: ``iter`` (required; 0-based boosting iteration — the fault fires
-BEFORE that iteration runs) and ``rank`` (optional ``jax`` process
-index; default: every process). Examples: ``"kill:rank=1,iter=10"``,
-``"exn:iter=5"``.
+BEFORE that iteration runs; ``slow`` keeps firing every iteration >=
+``iter``), ``rank`` (optional ``jax`` process index; default: every
+process), ``ms`` (``slow``/``hang``: delay per fire / max wedge time,
+default 200 / wedge-forever), ``target`` and ``nbytes`` (``corrupt``:
+what to damage and how many bytes to flip, default ``ckpt`` / 8).
+Examples: ``"kill:rank=1,iter=10"``, ``"hang:rank=0,iter=6"``,
+``"corrupt:iter=8,target=both"``, ``"slow:iter=3,ms=250;exn:iter=9"``.
+
+Determinism: every random choice a fault makes (which bytes ``corrupt``
+flips) is drawn from a PRNG seeded by the spec text itself
+(:func:`spec_seed`), so a CI failure replays byte-for-byte from the
+spec alone.
 
 Fire-once semantics: when a marker directory is available (explicit
-``tpu_fault_marker``, else ``checkpoint_dir``), firing writes a marker
-file keyed by (spec, rank); a restarted process that replays the same
-iteration skips the fault instead of dying forever in a restart loop.
-Without a marker directory the fault fires on every matching pass —
-fine for single-shot tests, wrong for restart loops (documented in
-docs/robustness.md).
+``tpu_fault_marker``, else ``checkpoint_dir``), firing a TERMINAL or
+DAMAGING fault (kill/exn/hang/corrupt/port) writes a marker file keyed
+by (spec, rank); a restarted process that replays the same iteration
+skips the fault instead of dying forever in a restart loop. ``slow``
+never writes markers (it is not terminal and must keep firing to model
+a persistently slow rank). Without a marker directory terminal faults
+fire on every matching pass — fine for single-shot tests, wrong for
+restart loops (documented in docs/robustness.md). Fresh (non-resume)
+runs clear THEIR rank's stale markers at setup so yesterday's marker
+cannot suppress today's injected fault (:func:`clear_fault_markers`);
+gang relaunches keep them (the launcher marks relaunched workers via
+``LGBM_TPU_GANG_RELAUNCH``).
 """
 from __future__ import annotations
 
 import hashlib
 import os
 import signal
+import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..utils import log
 from ..utils.log import LightGBMError
 
-__all__ = ["FaultPlan", "parse_fault_spec", "fault_injection_callback"]
+__all__ = ["FaultPlan", "parse_fault_spec", "parse_fault_specs",
+           "fault_injection_callback", "clear_fault_markers",
+           "spec_seed", "FAULT_KINDS"]
+
+FAULT_KINDS = ("kill", "exn", "hang", "slow", "corrupt", "port")
+
+# keys each kind accepts beyond the required ``iter`` (+ optional
+# ``rank``); unknown keys are a spec typo the user must hear about
+_KIND_KEYS: Dict[str, tuple] = {
+    "kill": (),
+    "exn": (),
+    "hang": ("ms",),
+    "slow": ("ms",),
+    "corrupt": ("target", "nbytes"),
+    "port": (),
+}
+
+# message shaped to match recovery/restart.py's _BIND_TOKENS so the
+# launcher's bind-retry path (fresh port, no restart attempt consumed)
+# triggers exactly as it would on the real _free_port race
+_PORT_MSG = ("tpu_fault_inject: injected coordinator bind conflict "
+             "(address already in use) before iteration {it} ({spec!r})")
 
 
 def _current_rank() -> int:
@@ -40,23 +93,157 @@ def _current_rank() -> int:
         return 0
 
 
+def spec_seed(spec: str) -> int:
+    """Deterministic 32-bit seed derived from the spec text — every
+    random draw a fault makes keys on this, so a CI failure replays
+    from the logged spec alone."""
+    return int(hashlib.sha1(str(spec).encode("utf-8")).hexdigest()[:8],
+               16)
+
+
+def clear_fault_markers(directory, rank: Optional[int] = None) -> int:
+    """Remove stale ``.fault_fired.*`` markers from ``directory`` —
+    THIS rank's only when ``rank`` is given (worker-side fresh-run
+    hygiene: each rank owns its own markers, so a slow-starting rank
+    can never clear a marker a faster rank just wrote), every rank's
+    when None (driver-side, before any worker exists). Returns the
+    count removed."""
+    directory = str(directory or "")
+    if not directory:
+        return 0
+    suffix = None if rank is None else f".rank{int(rank)}"
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.startswith(".fault_fired."):
+            continue
+        if suffix is not None and not name.endswith(suffix):
+            continue
+        try:
+            os.unlink(os.path.join(directory, name))
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
 @dataclass
 class FaultPlan:
-    kind: str                   # "kill" | "exn"
+    kind: str                   # one of FAULT_KINDS
     iteration: int              # fires before this 0-based iteration
     rank: Optional[int]         # None = every process
     marker_dir: str             # "" = no fire-once marker
     spec: str                   # original spec text (for messages)
+    ms: int = 0                 # slow: delay per fire; hang: wall-
+    #                             clock cap when > 0 (tests), else
+    #                             wedge forever
+    target: str = "ckpt"        # corrupt: ckpt | latest | both
+    nbytes: int = 8             # corrupt: bytes flipped per file
+    ckpt_dir: str = ""          # corrupt: where checkpoints live
 
     def marker_path(self, rank: int) -> str:
         h = hashlib.sha1(self.spec.encode("utf-8")).hexdigest()[:10]
         return os.path.join(self.marker_dir,
                             f".fault_fired.{h}.rank{rank}")
 
+    # -- per-kind behaviors ---------------------------------------------
+    def _fire_kill(self, rank: int) -> None:
+        log.warning(f"tpu_fault_inject: killing process (rank "
+                    f"{rank}) before iteration {self.iteration} "
+                    f"({self.spec!r})")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def _fire_hang(self, rank: int) -> None:
+        """Wedge: stop stamping heartbeats and sleep. Models a rank
+        stuck pre-collective — from outside it is alive (the process
+        exists, no exit code) but makes no progress; only the
+        watchdog's SIGKILL (or the optional ``ms`` cap, for tests
+        without a watchdog) ends it."""
+        cap = self.ms / 1000.0 if self.ms > 0 else float("inf")
+        log.warning(
+            f"tpu_fault_inject: hanging process (rank {rank}) before "
+            f"iteration {self.iteration} ({self.spec!r}; "
+            + (f"max {cap:.1f}s)" if cap != float("inf")
+               else "until killed)"))
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < cap:
+            time.sleep(0.25)
+        raise LightGBMError(
+            f"tpu_fault_inject: hang released after {self.ms} ms "
+            f"without being killed ({self.spec!r})")
+
+    def _fire_slow(self, rank: int) -> None:
+        time.sleep(max(self.ms, 1) / 1000.0)
+
+    def _fire_corrupt(self, rank: int) -> None:
+        """Flip ``nbytes`` PAYLOAD bytes of the newest checkpoint (and/
+        or clobber the ``latest`` pointer) — deterministic offsets from
+        :func:`spec_seed`. Damages rank 0's files (the restart-decision
+        source of truth) so the loader's fallback walk is what gets
+        exercised."""
+        import random
+
+        from .checkpoint import CheckpointManager
+        d = self.ckpt_dir or self.marker_dir
+        if not d or not os.path.isdir(d):
+            log.warning(f"tpu_fault_inject: corrupt fault has no "
+                        f"checkpoint dir to damage ({self.spec!r}); "
+                        f"skipping")
+            return
+        rng = random.Random(spec_seed(self.spec))
+        mgr = CheckpointManager(d, rank=0)
+        if self.target in ("ckpt", "both"):
+            its = mgr.iterations()
+            if its:
+                p = mgr.path(its[-1])
+                try:
+                    with open(p, "r+b") as f:
+                        f.seek(0, os.SEEK_END)
+                        size = f.tell()
+                        # flip bytes in the back half: always payload,
+                        # never just the header line a cheap parse
+                        # would catch — the sha256 must do the work
+                        for _ in range(max(1, self.nbytes)):
+                            off = rng.randrange(size // 2, size)
+                            f.seek(off)
+                            b = f.read(1)
+                            f.seek(off)
+                            f.write(bytes([b[0] ^ 0xFF]))
+                    log.warning(
+                        f"tpu_fault_inject: corrupted checkpoint {p} "
+                        f"({self.nbytes} byte(s) flipped, seed "
+                        f"{spec_seed(self.spec):#x}; {self.spec!r})")
+                except OSError as e:
+                    log.warning(f"tpu_fault_inject: cannot corrupt "
+                                f"{p}: {e}")
+        if self.target in ("latest", "both"):
+            try:
+                with open(mgr.latest_pointer, "w") as f:
+                    f.write("ckpt_99999999.rank0.ckpt\n")
+                log.warning(
+                    f"tpu_fault_inject: clobbered latest pointer "
+                    f"{mgr.latest_pointer} ({self.spec!r})")
+            except OSError as e:
+                log.warning(f"tpu_fault_inject: cannot clobber latest "
+                            f"pointer: {e}")
+
+    # -- dispatch -------------------------------------------------------
     def maybe_fire(self, iteration: int) -> None:
         """Fire the fault if ``iteration`` matches and it has not fired
-        before (per the marker file). ``kill`` does not return."""
-        if int(iteration) != self.iteration:
+        before (per the marker file). ``kill`` does not return; ``hang``
+        returns only when capped; ``slow`` fires on EVERY iteration >=
+        its target and never writes markers."""
+        it = int(iteration)
+        if self.kind == "slow":
+            if it >= self.iteration:
+                rank = _current_rank()
+                if self.rank is None or rank == self.rank:
+                    self._fire_slow(rank)
+            return
+        if it != self.iteration:
             return
         rank = _current_rank()
         if self.rank is not None and rank != self.rank:
@@ -71,49 +258,95 @@ class FaultPlan:
             with open(mp, "w") as f:
                 f.write(self.spec + "\n")
         if self.kind == "kill":
-            log.warning(f"tpu_fault_inject: killing process (rank "
-                        f"{rank}) before iteration {self.iteration} "
-                        f"({self.spec!r})")
-            os.kill(os.getpid(), signal.SIGKILL)
+            self._fire_kill(rank)
+        if self.kind == "hang":
+            self._fire_hang(rank)
+        if self.kind == "corrupt":
+            self._fire_corrupt(rank)
+            return                       # damage done; training goes on
+        if self.kind == "port":
+            raise LightGBMError(
+                _PORT_MSG.format(it=self.iteration, spec=self.spec))
         raise LightGBMError(
             f"tpu_fault_inject: injected failure before iteration "
             f"{self.iteration} ({self.spec!r})")
 
 
-def parse_fault_spec(spec: str, marker_dir: str = "") -> FaultPlan:
+def parse_fault_spec(spec: str, marker_dir: str = "",
+                     ckpt_dir: str = "") -> FaultPlan:
+    """Parse ONE ``kind:key=value,...`` spec (see module docstring)."""
     s = str(spec).strip()
     kind, _, rest = s.partition(":")
     kind = kind.strip().lower()
-    if kind not in ("kill", "exn"):
+    if kind not in FAULT_KINDS:
         log.fatal(f"tpu_fault_inject: unknown fault kind {kind!r} in "
-                  f"{spec!r} (expected 'kill:...' or 'exn:...')")
-    fields = {}
+                  f"{spec!r} (expected one of "
+                  f"{'|'.join(FAULT_KINDS)})")
+    allowed = ("iter", "rank") + _KIND_KEYS[kind]
+    fields: Dict[str, object] = {}
     for tok in rest.split(","):
         tok = tok.strip()
         if not tok:
             continue
         k, _, v = tok.partition("=")
         k, v = k.strip(), v.strip()
-        if k not in ("iter", "rank") or not v.lstrip("-").isdigit():
+        if k not in allowed:
             log.fatal(f"tpu_fault_inject: cannot parse {tok!r} in "
-                      f"{spec!r} (expected iter=<n> and optional "
-                      f"rank=<n>)")
-        fields[k] = int(v)
+                      f"{spec!r} (a {kind!r} fault takes "
+                      f"{', '.join(allowed)})")
+        if k == "target":
+            if v not in ("ckpt", "latest", "both"):
+                log.fatal(f"tpu_fault_inject: target must be ckpt, "
+                          f"latest or both (got {v!r} in {spec!r})")
+            fields[k] = v
+        else:
+            if not v.lstrip("-").isdigit():
+                log.fatal(f"tpu_fault_inject: cannot parse {tok!r} in "
+                          f"{spec!r} ({k}=<int> expected)")
+            fields[k] = int(v)
     if "iter" not in fields:
         log.fatal(f"tpu_fault_inject: {spec!r} needs an iter=<n> field")
-    return FaultPlan(kind=kind, iteration=fields["iter"],
+    if kind == "corrupt" and "rank" not in fields:
+        # corrupt damages rank 0's files; with rank unset EVERY rank
+        # would run the same spec-seeded XOR flips on the same bytes —
+        # an even number of passes RESTORES the file (and interleaved
+        # writes break the byte-for-byte replay guarantee). One rank
+        # fires, deterministically.
+        fields["rank"] = 0
+    return FaultPlan(kind=kind, iteration=int(fields["iter"]),
                      rank=fields.get("rank"),
-                     marker_dir=str(marker_dir or ""), spec=s)
+                     marker_dir=str(marker_dir or ""), spec=s,
+                     ms=int(fields.get("ms",
+                                       200 if kind == "slow" else 0)),
+                     target=str(fields.get("target", "ckpt")),
+                     nbytes=int(fields.get("nbytes", 8)),
+                     ckpt_dir=str(ckpt_dir or ""))
 
 
-def fault_injection_callback(spec: str, marker_dir: str = "") -> Callable:
-    """Before-iteration training callback wrapping a parsed fault plan
-    (wired by ``engine.train`` when ``tpu_fault_inject`` is set)."""
-    plan = parse_fault_spec(spec, marker_dir)
+def parse_fault_specs(spec: str, marker_dir: str = "",
+                      ckpt_dir: str = "") -> List[FaultPlan]:
+    """Parse a ``;``-separated fault MATRIX into its plans (order
+    preserved — a ``slow`` delay runs before an ``exn`` raise at the
+    same iteration when written that way)."""
+    return [parse_fault_spec(part, marker_dir, ckpt_dir)
+            for part in str(spec).split(";") if part.strip()]
+
+
+def fault_injection_callback(spec: str, marker_dir: str = "",
+                             ckpt_dir: str = "") -> Callable:
+    """Before-iteration training callback wrapping the parsed fault
+    plan(s) (wired by ``engine.train`` when ``tpu_fault_inject`` is
+    set). ``ckpt_dir`` tells ``corrupt`` faults where the checkpoints
+    live (defaults to the marker dir)."""
+    plans = parse_fault_specs(spec, marker_dir, ckpt_dir)
+    if not plans:
+        log.fatal(f"tpu_fault_inject: {spec!r} holds no fault spec")
 
     def _callback(env) -> None:
-        plan.maybe_fire(env.iteration)
+        for plan in plans:
+            plan.maybe_fire(env.iteration)
     _callback.before_iteration = True
     _callback.order = -100          # fire before any real callback work
-    _callback.fault_plan = plan
+    _callback.fault_plan = plans[0]
+    _callback.fault_plans = plans
     return _callback

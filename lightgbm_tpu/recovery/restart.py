@@ -3,9 +3,9 @@
 ``parallel.launch.train_distributed`` owns the actual restart loop
 (terminate the gang, pick a fresh coordinator port, resume every rank
 from the newest valid rank-0 checkpoint); this module keeps the policy
-pieces — exponential backoff, bind-failure classification for the
-coordinator-port race, and the "is there anything to resume from"
-check — separately testable.
+pieces — exponential backoff with decorrelated jitter, bind-failure
+classification for the coordinator-port race, and the "is there
+anything to resume from" check — separately testable.
 """
 from __future__ import annotations
 
@@ -27,12 +27,29 @@ _BIND_TOKENS = (
 
 
 def backoff_seconds(attempt: int, base: float = 1.0,
-                    cap: float = 30.0) -> float:
-    """Exponential backoff for restart attempt N (1-based): base *
-    2**(N-1), capped."""
+                    cap: float = 30.0, rng=None,
+                    prev: float = 0.0) -> float:
+    """Backoff for restart attempt N (1-based).
+
+    Without ``rng``: plain exponential ``base * 2**(N-1)``, capped —
+    deterministic, for single callers and tests.
+
+    With ``rng`` (a ``random.Random``): DECORRELATED JITTER
+    (``uniform(base, 3 * prev)``, capped; ``prev`` is the previous
+    returned delay, ``base`` when first). N gang drivers (or N ranks
+    each re-running the same call after a shared preemption) would
+    otherwise sleep IDENTICAL exponential delays and stampede the
+    coordinator port in lockstep on every attempt — the exact
+    ``_free_port`` bind race the bind-retry counter exists to absorb;
+    jitter spreads the relaunches so most attempts never collide at
+    all. Deterministic for a seeded rng, so tests replay."""
     if attempt <= 0:
         return 0.0
-    return float(min(cap, base * (2.0 ** (attempt - 1))))
+    if rng is None:
+        return float(min(cap, base * (2.0 ** (attempt - 1))))
+    lo = min(base, cap)
+    hi = max(lo, 3.0 * (prev if prev > 0.0 else base))
+    return float(min(cap, rng.uniform(lo, hi)))
 
 
 def is_bind_failure(err_text: str) -> bool:

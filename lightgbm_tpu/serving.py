@@ -1,0 +1,324 @@
+"""Serve-side zero-downtime model hot-swap (``tpu_model_watch``).
+
+The continuous-training loop (ROADMAP item 5) ends at a serving
+process that must pick up freshly published models WITHOUT dropping a
+request or recompiling its warm predict path: a trainer publishes
+atomic, sha256-verified checkpoints (recovery/checkpoint.py) and the
+server polls the ``latest`` pointer, adopting each new model the
+moment it verifies.
+
+Design:
+
+- **Polling rides the predict path** (no background thread): each
+  ``Booster.predict`` first calls :meth:`ModelWatcher.maybe_swap`,
+  which is one monotonic-clock read when inside the poll interval
+  (``tpu_model_watch_interval``, default 2 s). Swap and predict run on
+  the same thread, so a request observes either the old or the new
+  model atomically — ZERO dropped requests by construction. THREADING
+  CONTRACT: that atomicity is per-thread; warm adoption mutates the
+  live engine (models list, caches), so a MULTI-THREADED server must
+  serialize predicts against swaps itself (one serving loop per
+  Booster, or an external read/write lock) — concurrent predicts
+  during a swap may observe a mid-swap engine.
+- **Warm adoption**: when the serving Booster has a resident engine
+  and the checkpoint carries pickled trees from a compatible engine
+  (GBDT / StreamingGBDT — DART/RF carry mutable per-tree state and
+  take the host-model path), the watcher swaps the engine's tree list
+  in place and invalidates the stacked-forest cache. The engine is
+  pinned to STABLE predict shapes (pow2-padded tree count, config
+  num_leaves) so successive models in the same size bucket reuse every
+  compiled program — zero warm-path recompiles, CompileWatch-pinned.
+  Warm adoption requires the server to share the trainer's binning
+  pipeline (the adopted trees' ``threshold_bin`` values are only
+  meaningful against the same BinMappers — true for a trainer serving
+  its own models, or a server constructed over the same dataset/params;
+  a model-file-loaded Booster takes the host-model path, which uses
+  real-valued thresholds and has no such coupling).
+- **Graceful degradation**: a corrupt or half-written newest
+  checkpoint NEVER takes the server down — the loader falls back to
+  the newest valid file (possibly the one already serving), the
+  previous model keeps serving, and the ``serve.model_stale`` gauge
+  flips to 1 (with ``serve.swap_failures`` counting) until a good
+  checkpoint lands. ``train.freshness_lag_s`` tracks how far behind
+  the served model is at every poll.
+
+Metrics (forced — swap events are rare and must be visible even with
+the metrics pillar off; docs/observability.md catalogue):
+``serve.swaps``, ``serve.swap_failures``, ``serve.model_stale``,
+``serve.model_iteration``, ``train.freshness_lag_s``.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import obs
+from .recovery.checkpoint import CheckpointError, CheckpointManager
+from .utils import log
+
+__all__ = ["ModelWatcher"]
+
+# engines whose checkpointed tree lists are safe to adopt in place:
+# plain additive forests (DART rescales trees in place per iteration,
+# RF folds a bias — their checkpoints swap via model_str instead)
+_WARM_ENGINES = ("GBDT", "StreamingGBDT")
+
+
+class ModelWatcher:
+    """Polls one checkpoint directory and hot-swaps its newest valid
+    model into a serving Booster (wired by the ``tpu_model_watch``
+    param, or explicitly via ``Booster.watch_checkpoints``)."""
+
+    def __init__(self, directory: str, interval: float = 2.0,
+                 rank: int = 0):
+        self.dir = str(directory)
+        self.interval = max(float(interval), 0.0)
+        self.rank = int(rank)
+        self._mgr = CheckpointManager(self.dir, rank=self.rank)
+        # first-adoption baseline: publishes from BEFORE the watch
+        # started only adopt when they are not behind the model the
+        # booster already holds (see the forward rule in maybe_swap)
+        self._install_ns = time.time_ns()
+        self._last_poll = 0.0
+        self._last_sig: Optional[tuple] = None
+        self._loaded_iteration = -1      # iteration currently serving
+        self._loaded_key: Optional[tuple] = None   # (it, mtime_ns, size)
+        self._loaded_mtime: Optional[float] = None
+        self.swaps = 0
+        self.failures = 0
+        self.stale = False
+
+    # ------------------------------------------------------------------
+    def _file_id(self, path: str) -> Optional[tuple]:
+        try:
+            st = os.stat(path)
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _signature(self) -> tuple:
+        """Cheap change detector: (latest-pointer text, newest NAMED
+        checkpoint iteration, newest file's (mtime_ns, size)). Any
+        publish — good, torn, a clobbered pointer, or a REPUBLISH at
+        the same iteration (continuous training retrains N rounds
+        every cycle, so successive models share an iteration count) —
+        changes it; nothing changed means no load attempt, so
+        steady-state polls cost a few stats, not an unpickle."""
+        ptr = None
+        try:
+            with open(self._mgr.latest_pointer) as f:
+                ptr = f.read().strip()
+        except OSError:
+            pass
+        its = self._mgr.iterations()
+        newest = its[-1] if its else None
+        newest_id = (self._file_id(self._mgr.path(newest))
+                     if newest is not None else None)
+        return (ptr, newest, newest_id)
+
+    def _newest_named_iteration(self) -> int:
+        its = self._mgr.iterations()
+        return its[-1] if its else -1
+
+    # ------------------------------------------------------------------
+    def maybe_swap(self, booster, force: bool = False) -> bool:
+        """Poll (rate-limited unless ``force``) and swap if a new
+        checkpoint verifies. Returns True when a swap happened. Never
+        raises for checkpoint-side problems — a serving process must
+        keep serving the previous model through ANY publish failure."""
+        now = time.monotonic()
+        if not force and now - self._last_poll < self.interval:
+            return False
+        self._last_poll = now
+        try:
+            sig = self._signature()
+        except Exception:
+            return False
+        if sig == self._last_sig and not force:
+            self._refresh_freshness()
+            return False
+        newest_id = sig[2]        # newest NAMED file's (mtime_ns, size)
+        swapped = False
+        try:
+            state = self._mgr.load()
+        except CheckpointError as e:
+            # deterministic verification failure: nothing valid AT ALL
+            # (or dir empty) — keep serving, and COMMIT the signature
+            # (the same bytes fail the same way; re-unpickling every
+            # poll would be waste)
+            self._last_sig = sig
+            if sig[1] is not None:       # something IS published
+                self.failures += 1
+                obs.inc("serve.swap_failures", force=True)
+                log.warning(f"model watch: no valid checkpoint in "
+                            f"{self.dir} ({e}); keeping the current "
+                            f"model")
+            self._update_stale(newest_id)
+            return False
+        except Exception as e:
+            # TRANSIENT failure (I/O blip, memory pressure mid-
+            # unpickle): keep serving but do NOT commit the signature —
+            # the next poll must retry this same publish, or a one-off
+            # error would pin the server on the old model until the
+            # NEXT publish with no staleness alert
+            self.failures += 1
+            obs.inc("serve.swap_failures", force=True)
+            log.warning(f"model watch: cannot read {self.dir} ({e}); "
+                        f"keeping the current model (will retry)")
+            self._update_stale(newest_id)
+            return False
+        it = int(state.get("iteration", -1))
+        path = state.get("_checkpoint_path")
+        file_id = self._file_id(path) if path else None
+        key = (it, file_id)
+        # adopt only FORWARD: a checkpoint file no older than the one
+        # serving. The loader's corruption fallback can hand back an
+        # OLDER on-disk checkpoint than the model already in memory
+        # (newest torn, previous still on disk) — swapping to it would
+        # silently downgrade the served model; staleness flags it
+        # instead and the next good publish moves forward again. A
+        # REPUBLISH at the same iteration (continuous training) is a
+        # newer file and swaps normally. FIRST adoption baselines
+        # against the model the booster already holds: a publish from
+        # BEFORE the watch started (a trainer watching its own
+        # checkpoint dir finds its latest ROUND-BOUNDARY snapshot — a
+        # prefix of the model in memory) must not downgrade it; it
+        # adopts only when not behind (iteration >=), while anything
+        # published AFTER the watch started adopts unconditionally.
+        if self._loaded_key is None:
+            forward = (file_id is not None
+                       and file_id[0] >= self._install_ns) \
+                or it >= self._booster_iteration(booster)
+        else:
+            forward = (self._loaded_key[1] is None
+                       or (file_id is not None
+                           and file_id[0] >= self._loaded_key[1][0]))
+        if key != self._loaded_key and forward:
+            try:
+                self._adopt(booster, state)
+                self._loaded_iteration = it
+                self._loaded_key = key
+                self._loaded_mtime = self._ckpt_mtime(state)
+                self.swaps += 1
+                obs.inc("serve.swaps", force=True)
+                obs.set_gauge("serve.model_iteration", it, force=True)
+                log.info(f"model watch: hot-swapped to checkpoint "
+                         f"iteration {it} from {self.dir} "
+                         f"(swap #{self.swaps})")
+                swapped = True
+            except Exception as e:
+                self.failures += 1
+                obs.inc("serve.swap_failures", force=True)
+                log.warning(f"model watch: cannot adopt checkpoint "
+                            f"iteration {it} ({e}); keeping the "
+                            f"current model (will retry)")
+                # like a transient LOAD failure: do not commit the
+                # signature, so the next poll retries this publish
+                # instead of pinning on the old model until the next
+                self._update_stale(newest_id)
+                self._refresh_freshness()
+                return False
+        self._last_sig = sig
+        self._update_stale(newest_id)
+        self._refresh_freshness()
+        return swapped
+
+    @staticmethod
+    def _booster_iteration(booster) -> int:
+        try:
+            return int(booster.current_iteration())
+        except Exception:
+            return -1
+
+    def _update_stale(self, newest_id: Optional[tuple]) -> None:
+        """Stale = the newest PUBLISHED file is not the one serving —
+        a torn newest write the loader skipped, a fallback the watcher
+        refused to downgrade to, or an adoption failure. An empty dir
+        (nothing published yet) is not stale."""
+        adopted_id = (self._loaded_key[1] if self._loaded_key
+                      else None)
+        self._set_stale(newest_id is not None
+                        and newest_id != adopted_id)
+
+    # ------------------------------------------------------------------
+    def _ckpt_mtime(self, state: Dict[str, Any]) -> Optional[float]:
+        path = state.get("_checkpoint_path")
+        if not path:
+            return None
+        try:
+            return os.stat(path).st_mtime
+        except OSError:
+            return None
+
+    def _refresh_freshness(self) -> None:
+        """train.freshness_lag_s = age of the checkpoint the served
+        model came from — the end-to-end publish->serve lag the chaos
+        benchmark reports, and the gauge that keeps growing while a
+        corrupt publisher leaves the server pinned on an old model."""
+        if self._loaded_mtime is not None:
+            obs.set_gauge("train.freshness_lag_s",
+                          max(0.0, time.time() - self._loaded_mtime),
+                          force=True)
+
+    def _set_stale(self, stale: bool) -> None:
+        stale = bool(stale)
+        if stale != self.stale:
+            log.warning(f"model watch: serving model is now "
+                        f"{'STALE' if stale else 'fresh'} "
+                        f"(iteration {self._loaded_iteration}, newest "
+                        f"published {self._newest_named_iteration()})")
+        self.stale = stale
+        obs.set_gauge("serve.model_stale", 1.0 if stale else 0.0,
+                      force=True)
+
+    # ------------------------------------------------------------------
+    def _adopt(self, booster, state: Dict[str, Any]) -> None:
+        """Swap the checkpoint's model into ``booster`` — warm
+        in-engine tree adoption where safe, host-model rebuild
+        otherwise. Raises on an unusable checkpoint (caught by
+        maybe_swap: the previous model keeps serving)."""
+        est = state.get("engine") or {}
+        trees = est.get("models")
+        eng = getattr(booster, "_engine", None)
+        if (eng is not None and trees is not None
+                and est.get("engine") in _WARM_ENGINES
+                and type(eng).__name__ in _WARM_ENGINES
+                # tree count must factor through THIS engine's
+                # num_class (a multiclass checkpoint adopted into a
+                # binary server would traverse the wrong class slots —
+                # it takes the host-model path instead)
+                and int(state.get("iteration", -1))
+                * max(eng.num_class, 1) == len(trees)):
+            # warm path: adopt the exact pickled trees; the stacked-
+            # forest cache rebuilds once (a cache MISS, not a compile —
+            # shapes stay bucketed via _stable_predict_shapes)
+            eng.models = list(trees)
+            eng.iter_ = len(eng.models) // max(eng.num_class, 1)
+            if est.get("init_scores") is not None:
+                eng.init_scores = np.asarray(est["init_scores"],
+                                             np.float64)
+            if hasattr(eng, "_invalidate_forest_cache"):
+                eng._invalidate_forest_cache()
+            else:
+                eng._models_version = getattr(eng, "_models_version",
+                                              0) + 1
+            eng._hm_cache = (None, None)
+            eng._stable_predict_shapes = True
+            # an earlier swap may have taken the host-model path and
+            # set _from_model, which predict() checks FIRST — leaving
+            # it would make this (and every later) warm swap invisible
+            booster._from_model = None
+        else:
+            model_str = state.get("model_str")
+            if not model_str:
+                raise CheckpointError(
+                    "checkpoint carries neither adoptable engine trees "
+                    "nor model_str")
+            from .io.model_text import load_model_string
+            booster._from_model = load_model_string(model_str)
+        bstate = state.get("booster") or {}
+        booster.best_iteration = int(bstate.get("best_iteration", -1))
+        booster._host_model_cache = None

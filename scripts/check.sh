@@ -60,13 +60,22 @@ JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_ca
 python -c "import __graft_entry__ as g; g.dryrun_multichip(2, only=('streaming',))" \
   || STREAM_DRYRUN=0
 
+# chaos smoke (docs/robustness.md "Chaos harness"): kill + resume +
+# hot-swap in one process — streamed resume must stay BIT-EQUAL to the
+# uninterrupted run, the swap must compile nothing, and a corrupted
+# publish must degrade gracefully; its status rides the obs line so
+# scripts/obs_trend.py fails absolutely on chaos_smoke=0
+CHAOS_SMOKE=1
+JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
+python benchmarks/chaos_bench.py --smoke || CHAOS_SMOKE=0
+
 # machine-readable obs line appended next to the plain timing line:
 # dots/seconds from this run plus compile count and peak-HBM estimate
 # read back from the snapshot. A malformed dump FAILS the gate — a
 # check that silently skips its own telemetry is how telemetry rots.
-python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" <<'PY' >> scripts/check_timings.log
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" <<'PY' >> scripts/check_timings.log
 import json, sys, time
-path, mode, dots, secs, rev, stream_ok = sys.argv[1:7]
+path, mode, dots, secs, rev, stream_ok, chaos_ok = sys.argv[1:8]
 try:
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     snap = json.loads(lines[-1])
@@ -100,12 +109,18 @@ print("obs " + json.dumps({
     "stream_rows_per_sec": gauge("bench.stream_rows_per_sec"),
     "stream_shards": gauge("bench.stream_shards"),
     "stream_dryrun": int(stream_ok),
+    # kill + resume + hot-swap loop (benchmarks/chaos_bench.py --smoke)
+    "chaos_smoke": int(chaos_ok),
 }))
 PY
 
 if [[ "$STREAM_DRYRUN" != 1 ]]; then
   echo "check.sh: streamed-sharded dryrun FAILED (status logged)"
   exit 4
+fi
+if [[ "$CHAOS_SMOKE" != 1 ]]; then
+  echo "check.sh: chaos smoke FAILED (kill+resume+swap; status logged)"
+  exit 5
 fi
 
 # perf-regression sentinel (CHECK_TREND=1 to enforce): compare the obs

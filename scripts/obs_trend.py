@@ -19,7 +19,10 @@ regressed past its threshold —
   non-lower dot count (fewer dots = different suite, not a slowdown);
 - ``stream_dryrun`` == 0 in the NEWEST run (absolute, no baseline
   needed): the streamed-sharded dryrun check.sh runs diverged from
-  single-shard streaming or crashed.
+  single-shard streaming or crashed;
+- ``chaos_smoke`` == 0 in the NEWEST run (absolute, like
+  stream_dryrun): the kill + resume + hot-swap chaos smoke check.sh
+  runs lost bit-equality, dropped a request, or crashed.
 
 No (or not enough) history exits 0 — the first run after a wipe stays
 green. A signal missing from either side of the comparison is skipped
@@ -121,6 +124,14 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
             "streamed-sharded dryrun FAILED (stream_dryrun=0): the "
             "2-device streaming case diverged from single-shard "
             "streaming or crashed")
+    # the chaos-smoke pin is absolute for the same reason: a resume
+    # that lost bit-equality or a hot-swap that dropped/corrupted a
+    # request is broken NOW, whatever the trailing median says
+    if _num(newest, "chaos_smoke") == 0.0:
+        failures.append(
+            "chaos smoke FAILED (chaos_smoke=0): kill + resume + "
+            "hot-swap lost bit-equality or crashed "
+            "(benchmarks/chaos_bench.py --smoke)")
     mode = newest.get("mode")
     # rejected entries (previous sentinel failures) never become
     # baseline — a persistent regression re-run N times must keep

@@ -66,6 +66,24 @@ def test_empty_and_first_run_history_stay_green(tmp_path):
     assert proc.returncode == 2
 
 
+def test_chaos_smoke_failure_fails_even_without_history(tmp_path):
+    """The chaos-smoke pin is ABSOLUTE like stream_dryrun: a
+    chaos_smoke=0 newest entry fails with no baseline at all, and a
+    1 (or an absent key, for pre-chaos logs) stays green."""
+    bad = _obs_line()
+    bad = "obs " + json.dumps(
+        dict(json.loads(bad[len("obs "):]), chaos_smoke=0))
+    rc, out = _run(tmp_path, [bad])
+    assert rc == 1
+    assert "chaos" in out
+    good = "obs " + json.dumps(
+        dict(json.loads(_obs_line()[len("obs "):]), chaos_smoke=1))
+    rc, out = _run(tmp_path, [good])
+    assert rc == 0, out
+    rc, out = _run(tmp_path, [_obs_line()])   # key absent: pre-chaos
+    assert rc == 0, out
+
+
 def test_compile_and_hbm_regressions_fail(tmp_path):
     base = [_obs_line() for _ in range(4)]
     rc, out = _run(tmp_path, base + [_obs_line(compile_requests=200)])

@@ -123,6 +123,39 @@ def test_truncated_checkpoint_rejected_and_falls_back(tmp_path):
         mgr.load_file(p20)
 
 
+def test_multi_corruption_walks_back_to_oldest_valid_and_gang_agrees(
+        tmp_path):
+    """Satellite: corrupt the newest TWO checkpoints AND the latest
+    pointer — load() must walk back to the oldest valid file, and a
+    gang whose ranks saw different damage must still agree (min over
+    per-rank newest-valid iterations, the load_for_resume rule)."""
+    mgr0 = CheckpointManager(tmp_path, keep_n=5, rank=0)
+    mgr1 = CheckpointManager(tmp_path, keep_n=5, rank=1)
+    for it in (10, 20, 30):
+        mgr0.save({"version": 1, "n": it}, it)
+        mgr1.save({"version": 1, "n": it + 1}, it)
+
+    def corrupt(path):
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:-16] + bytes(16))
+
+    corrupt(mgr0.path(30))
+    corrupt(mgr0.path(20))
+    with open(mgr0.latest_pointer, "w") as f:
+        f.write("ckpt_99999999.rank0.ckpt\n")
+    # rank 0: pointer garbage, 30 and 20 corrupt -> walks back to 10
+    st = mgr0.load()
+    assert st["n"] == 10
+    assert mgr0.latest_valid_iteration() == 10
+    # rank 1 is undamaged, but the gang rule is min over ranks: both
+    # ranks must resume from 10, and BOTH can load that exact iteration
+    target = min(m.latest_valid_iteration() for m in (mgr0, mgr1))
+    assert target == 10
+    assert mgr0.load(iteration=target)["n"] == 10
+    assert mgr1.load(iteration=target)["n"] == 11
+
+
 def test_checkpoint_bad_magic_and_version(tmp_path):
     mgr = CheckpointManager(tmp_path, rank=0)
     p = tmp_path / "ckpt_00000001.rank0.ckpt"

@@ -1,0 +1,188 @@
+"""Streamed (out-of-core) checkpoint/resume — the one training engine
+that had NO recovery integration before this PR, in exactly the regime
+(long out-of-core runs) where preemption is the norm.
+
+The contract pinned here (ISSUE 9 acceptance): a streamed×sharded run
+interrupted by an injected fault and resumed from its newest
+round-boundary checkpoint is BIT-IDENTICAL to the uninterrupted run —
+at 1/2/4 shards × {plain, quantized, GOSS, bagging} — because
+everything nondeterministic is either checkpointed (scores, host RNG,
+pending round statistics) or a pure counter-hash of (seed, iteration,
+global row index) that needs no state at all. Plus: mid-bagging-window
+resume (the iter//freq salt makes it free), layout-change hard errors,
+and checkpoint-state completeness.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.recovery.checkpoint import CheckpointManager
+
+
+def _data(n=8_000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+# same shape family as tests/test_streaming_sharded.py BASE so the two
+# modules share jit compiles (block 2048, leaves 16, depth 4)
+BASE = {"objective": "binary", "num_leaves": 16, "max_depth": 4,
+        "verbosity": -1, "min_data_in_leaf": 20,
+        "tpu_streaming": "true", "tpu_stream_block_rows": 2_048}
+
+ROUNDS = 5
+KILL_AT = 3          # checkpoints at 2 and 4; the fault fires before 3
+INTERVAL = 2
+
+
+def _params(shards, ckpt_dir, **extra):
+    p = dict(BASE, checkpoint_dir=str(ckpt_dir),
+             checkpoint_interval=INTERVAL, **extra)
+    if shards > 1:
+        p["tree_learner"] = "data"
+        p["tpu_mesh_shape"] = shards
+    return p
+
+
+def _interrupt_and_resume(X, y, shards, tmp_path, rounds=ROUNDS,
+                          kill_at=KILL_AT, **extra):
+    """Straight run, chaos-interrupted run, resumed run — returns the
+    (straight, resumed) model texts."""
+    straight = lgb.train(_params(shards, tmp_path / "straight", **extra),
+                         lgb.Dataset(X, label=y), num_boost_round=rounds)
+    p = _params(shards, tmp_path / "chaos",
+                tpu_fault_inject=f"exn:iter={kill_at}", **extra)
+    with pytest.raises(lgb.LightGBMError, match="injected failure"):
+        lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    resumed = lgb.train(p, lgb.Dataset(X, label=y),
+                        num_boost_round=rounds,
+                        resume_from=str(tmp_path / "chaos"))
+    assert resumed.num_trees() == rounds
+    return straight.model_to_string(), resumed.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance matrix: 1/2/4 shards x plain/quantized/GOSS/bagging
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("shards", [1, 2, 4])
+@pytest.mark.parametrize("extra", [
+    {},
+    {"use_quantized_grad": True},
+    {"data_sample_strategy": "goss"},
+    {"bagging_fraction": 0.6, "bagging_freq": 2},
+], ids=["plain", "quant", "goss", "bagging"])
+def test_streamed_resume_bit_identical(extra, shards, tmp_path):
+    X, y = _data()
+    m_straight, m_resumed = _interrupt_and_resume(X, y, shards,
+                                                  tmp_path, **extra)
+    assert m_resumed == m_straight
+
+
+def test_streamed_resume_mid_bagging_window(tmp_path):
+    """Kill INSIDE a bagging_freq window (freq=3: window k=1 spans
+    iterations 3-5; the checkpoint at 4 resumes at iteration 4, mid-
+    window). The bagging salt is a hash of (bagging_seed, iter//freq)
+    — no host RNG stream to land mid-sequence in — so the resumed draw
+    for iterations 4 and 5 is identical by construction."""
+    X, y = _data(seed=3)
+    m_straight, m_resumed = _interrupt_and_resume(
+        X, y, 1, tmp_path, rounds=7, kill_at=5,
+        bagging_fraction=0.6, bagging_freq=3)
+    assert m_resumed == m_straight
+
+
+def test_streamed_resume_with_valid_set_and_early_stopping(tmp_path):
+    """The incremental valid-set raw cache and early-stopping state
+    ride the checkpoint: resumed eval decisions match bit-for-bit."""
+    X, y = _data(seed=5)
+    Xv, yv = X[6_000:], y[6_000:]
+    X, y = X[:6_000], y[:6_000]
+
+    def run(ckpt_dir, fault=None, resume=False):
+        ds = lgb.Dataset(X, label=y)
+        vs = ds.create_valid(Xv, label=yv)
+        p = _params(1, ckpt_dir, metric="auc", early_stopping_round=20)
+        if fault:
+            p["tpu_fault_inject"] = fault
+        if resume:
+            return lgb.train(p, ds, num_boost_round=8, valid_sets=[vs],
+                             resume_from=str(ckpt_dir))
+        return lgb.train(p, ds, num_boost_round=8, valid_sets=[vs])
+
+    straight = run(tmp_path / "s")
+    with pytest.raises(lgb.LightGBMError):
+        run(tmp_path / "c", fault="exn:iter=5")
+    resumed = run(tmp_path / "c", fault="exn:iter=5", resume=True)
+    assert resumed.model_to_string() == straight.model_to_string()
+    assert resumed.best_iteration == straight.best_iteration
+    assert resumed.best_score == straight.best_score
+
+
+# ---------------------------------------------------------------------------
+# guard rails
+# ---------------------------------------------------------------------------
+def test_streamed_resume_rejects_layout_change(tmp_path):
+    """Streamed scores are cut by the shard/block layout; resuming
+    under a different block size (or mesh) must be a hard error naming
+    what moved — there is no re-streaming score rebuild."""
+    X, y = _data(n=4_000)
+    p = _params(1, tmp_path)
+    lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=4)
+    changed = dict(p, tpu_stream_block_rows=1_024)
+    with pytest.raises(lgb.LightGBMError, match="layout|block"):
+        lgb.train(changed, lgb.Dataset(X, label=y), num_boost_round=6,
+                  resume_from=str(tmp_path))
+
+
+def test_streamed_resume_rejects_engine_mismatch(tmp_path):
+    """A resident-engine checkpoint resumed onto the streaming engine
+    (or vice versa) must fatal, not silently adopt half a state."""
+    X, y = _data(n=4_000)
+    resident = {"objective": "binary", "num_leaves": 16, "verbosity": -1,
+                "checkpoint_dir": str(tmp_path), "checkpoint_interval": 2}
+    lgb.train(resident, lgb.Dataset(X, label=y), num_boost_round=4)
+    streamed = dict(resident, tpu_streaming="true",
+                    tpu_stream_block_rows=2_048)
+    with pytest.raises(lgb.LightGBMError, match="GBDT engine"):
+        lgb.train(streamed, lgb.Dataset(X, label=y), num_boost_round=6,
+                  resume_from=str(tmp_path))
+
+
+def test_streamed_checkpoint_state_is_complete(tmp_path):
+    """The saved streamed engine state names every piece the resume
+    contract advertises (guards against silently dropping a field)."""
+    X, y = _data(n=4_000)
+    p = _params(2, tmp_path, data_sample_strategy="goss",
+                use_quantized_grad=True)
+    lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=4)
+    st = CheckpointManager(str(tmp_path), rank=0).load()
+    assert st["iteration"] == 4
+    eng = st["engine"]
+    assert eng["engine"] == "StreamingGBDT"
+    for key in ("iteration", "models", "init_scores", "rng", "layout",
+                "scores", "pending_stats", "valid_raw_cache"):
+        assert key in eng, key
+    lay = eng["layout"]
+    assert lay["R"] == 2 and len(lay["ranks"]) == 2
+    # per-(rank, block) score slots, padded to block_rows
+    assert len(eng["scores"]) == 2
+    assert all(s.dtype == np.float32 and len(s) == lay["block_rows"]
+               for per_rank in eng["scores"] for s in per_rank)
+    # GOSS+quant track round statistics; the fold from the final sweep
+    # must travel (recomputing could fuse differently under XLA)
+    assert eng["pending_stats"] is not None
+
+
+def test_streamed_fresh_run_still_clears_stale_checkpoints(tmp_path):
+    """The PR-6 fresh-run hygiene applies to the streamed engine too:
+    a non-resume streamed run claiming a used dir clears it."""
+    X, y = _data(n=4_000)
+    p = _params(1, tmp_path)
+    lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=4)
+    mgr = CheckpointManager(str(tmp_path), rank=0)
+    assert mgr.latest_valid_iteration() == 4
+    lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert mgr.iterations() == [2]
