@@ -14,32 +14,18 @@ __all__ = ["GBDT", "create_boosting"]
 
 
 def _streaming_compatible(config) -> bool:
-    """Configs StreamingGBDT.__init__ would accept (kept in sync with
-    its _no() gates — the drift-guard sweep in tests/test_streaming_
-    sharded.py pins the iff; auto mode must NEVER route a config into
-    a log.fatal that the resident engine would have trained).
+    """Configs StreamingGBDT.__init__ would accept — BOTH sides now
+    read lightgbm_tpu/capabilities.py, so the iff the drift-guard
+    sweep in tests/test_streaming_sharded.py pins holds by
+    construction (auto mode must NEVER route a config into a
+    log.fatal that the resident engine would have trained).
 
     Bagging, GOSS, quantized gradients and ``tree_learner=data`` (the
     sharded streamed path) are streaming-supported; voting/feature
-    learners and the structured-constraint features are not."""
-    return (config.tree_learner in ("serial", "data")
-            and config.boosting == "gbdt"
-            and config.num_tree_per_iteration == 1
-            # int16 per-row leaf-id state caps streamed trees
-            and int(config.num_leaves) <= 32767
-            and not bool(config.linear_tree)
-            and not bool(config.monotone_constraints)
-            and not bool(config.interaction_constraints)
-            # StreamingGBDT rejects ANY CEGB knob, including a bare
-            # non-default cegb_tradeoff
-            and config.cegb_tradeoff == 1.0
-            and config.cegb_penalty_split <= 0
-            and not bool(config.cegb_penalty_feature_coupled)
-            and not bool(config.cegb_penalty_feature_lazy)
-            and not bool(config.forcedsplits_filename)
-            and not bool(config.categorical_feature)
-            and str(config.objective) not in ("lambdarank",
-                                              "rank_xendcg", "custom"))
+    learners and the structured-constraint features are not — see the
+    "streaming" column of ``capabilities.CAPABILITIES``."""
+    from .. import capabilities
+    return capabilities.supports("streaming", config)
 
 
 def _should_stream(config, train_set, fobj) -> bool:
@@ -105,8 +91,12 @@ def _should_stream(config, train_set, fobj) -> bool:
 
 def create_boosting(config, train_set, fobj=None, mesh=None,
                     init_forest=None) -> GBDT:
+    # forced streaming x a non-gbdt boosting mode would dispatch AWAY
+    # from the streaming engine below — fatal early with clear wording
+    # (boosting is normalized to {gbdt, dart, rf} by Config; the
+    # table's dart/rf rows mark the same configs streaming-fatal)
     if (str(getattr(config, "tpu_streaming", "auto")) == "true"
-            and config.boosting in ("dart", "rf")):
+            and config.boosting != "gbdt"):
         from ..utils import log
         log.fatal(f"tpu_streaming=true supports boosting=gbdt only "
                   f"(got {config.boosting}); DART/RF need the resident "

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import obs
+from .. import capabilities, obs
 from ..config import Config
 from ..io.dataset import Dataset
 from ..learner.serial import GrowConfig, grow_tree
@@ -277,8 +277,10 @@ class _DeviceData:
 
 
 # tpu_auto_quantize only engages at the scale the A/B validated
-# (docs/perf.md): below this, exact f32 gradients are the default
-AUTO_QUANT_MIN_ROWS = 500_000
+# (docs/perf.md): below this, exact f32 gradients are the default.
+# Policy constants live in the capability table (capabilities.py);
+# this module-level alias stays monkeypatchable for tests.
+AUTO_QUANT_MIN_ROWS = capabilities.AUTO_QUANT_MIN_ROWS
 
 
 def goss_shard_valid_counts(n_local: int, n_pad_local: int,
@@ -358,9 +360,8 @@ class GBDT:
                 and not config.use_quantized_grad
                 and config.boosting == "gbdt" and fobj is None
                 and self.train_set.num_data >= AUTO_QUANT_MIN_ROWS
-                and str(config.objective) in (
-                    "binary", "regression", "multiclass",
-                    "multiclassova", "cross_entropy")):
+                and str(config.objective)
+                in capabilities.AUTO_QUANTIZE_OBJECTIVES):
             config.use_quantized_grad = True
             config._quantize_auto = True
             log.info("tpu_auto_quantize: enabling quantized gradients "
@@ -667,17 +668,15 @@ class GBDT:
         elif part_mode == "false":
             self.hist_partition = False
         else:
-            goss = str(config.data_sample_strategy) == "goss"
-            big = self.data.n_pad >= (1 << 20)
-            self.hist_partition = (can_part and self.use_pallas
-                                   and config.tpu_hist_mode == "pool"
-                                   and not goss and big)
-            if (can_part and self.use_pallas
-                    and config.tpu_hist_mode == "pool"
-                    and not self.hist_partition):
-                reason = ("GOSS already compacts the scan" if goss
-                          else "dataset too small to amortize the "
-                               "repartition move")
+            # the auto cost model lives in the capability table
+            # (capabilities.hist_partition_auto); this block only owns
+            # the warning etiquette
+            engage, reason = capabilities.hist_partition_auto(
+                config, self.use_pallas, self.data.n_pad)
+            self.hist_partition = can_part and engage
+            if can_part and not engage and reason is not None:
+                big = (self.data.n_pad
+                       >= capabilities.HIST_PARTITION_MIN_ROWS)
                 msg = (f"tpu_hist_partition=auto: staying on masked "
                        f"histograms ({reason}); set "
                        f"tpu_hist_partition=true to force")

@@ -31,15 +31,22 @@ class RandomForest(GBDT):
 
     def __init__(self, config, train_set, fobj=None, mesh=None,
                  init_forest=None):
-        use_bagging = (config.bagging_freq > 0
-                       and (config.bagging_fraction < 1.0
-                            or config.pos_bagging_fraction < 1.0
-                            or config.neg_bagging_fraction < 1.0))
-        if not use_bagging:
-            log.fatal("Random forest needs bagging: set bagging_freq > 0 "
-                      "and bagging_fraction < 1.0")
-        if config.data_sample_strategy == "goss":
-            log.fatal("Cannot use GOSS with random forest")
+        # eligibility from the capability table's "rf" column (the
+        # same rows the drift-guard sweep in tests/test_analysis.py
+        # constructs against); messages keep the reference wording
+        from .. import capabilities
+        for name, cap, v in capabilities.engine_verdicts("rf", config):
+            if v == capabilities.FATAL:
+                log.fatal(cap.messages.get("rf",
+                                           f"rf does not support "
+                                           f"{cap.describe}"))
+            else:
+                # a DEMOTE row added to the table without a demotion
+                # action here would be a silent no-op (same guard as
+                # StreamingGBDT's walk)
+                log.fatal(f"capability table DEMOTEs {name!r} for the "
+                          f"rf engine but RandomForest has no demotion "
+                          f"action for it — add one here")
         super().__init__(config, train_set, fobj=fobj, mesh=mesh,
                          init_forest=init_forest)
         self.average_output = True

@@ -186,36 +186,45 @@ class StreamingGBDT:
             if cond:
                 log.fatal(self._UNSUPPORTED_MSG.format(what=what))
 
-        _no(fobj is not None, "a custom objective function")
-        _no(init_forest is not None, "training continuation/init_model")
-        _no(config.tree_learner not in ("serial", "data"),
-            f"tree_learner={config.tree_learner} (streamed training "
-            f"shards ROWS; voting/feature-parallel search needs the "
-            f"resident column layout)")
+        # config-level eligibility: ONE walk of the capability table's
+        # "streaming" column (lightgbm_tpu/capabilities.py) — the same
+        # rows _streaming_compatible reads, so auto-routing and this
+        # constructor can no longer drift (the PR-5 bug class; the
+        # sweep in tests/test_streaming_sharded.py pins the iff).
+        # Runtime-only features ride the `extra` flags.
+        from .. import capabilities
+        for name, cap, v in capabilities.engine_verdicts(
+                "streaming", config,
+                extra={"custom_objective": fobj is not None,
+                       "continuation": init_forest is not None}):
+            if v == capabilities.FATAL:
+                _no(True, cap.describe)
+            elif name == "auto_quantize":
+                # DEMOTE: tpu_auto_quantize targets the resident int8
+                # histogram kernels; an un-asked-for discretization
+                # would change streamed numerics — quietly drop it. An
+                # EXPLICIT use_quantized_grad stays honored: integer
+                # level histograms are what make sharded streaming
+                # bit-exact and engage the packed collective wire.
+                config.use_quantized_grad = False
+            else:
+                # a DEMOTE row added to the table without a demotion
+                # action here would otherwise be a silent no-op — the
+                # one-side-edited drift this engine exists to refuse
+                log.fatal(f"capability table DEMOTEs {name!r} for the "
+                          f"streaming engine but StreamingGBDT has no "
+                          f"demotion action for it — add one here")
+        # runtime-shape gates (not feature drift; stay constructor-local)
         _no(mesh is not None and config.tree_learner == "serial",
             "an explicit mesh with tree_learner=serial")
-        _no(config.num_tree_per_iteration > 1, "multiclass")
-        _no(config.boosting in ("dart", "rf"), f"boosting={config.boosting}")
-        _no(bool(config.linear_tree), "linear_tree")
-        _no(bool(config.monotone_constraints), "monotone constraints")
-        _no(bool(config.interaction_constraints),
-            "interaction constraints")
-        _no(config.cegb_tradeoff != 1.0 or config.cegb_penalty_split > 0
-            or bool(config.cegb_penalty_feature_coupled)
-            or bool(config.cegb_penalty_feature_lazy), "CEGB")
-        _no(bool(config.forcedsplits_filename), "forced splits")
-        if getattr(config, "_quantize_auto", False):
-            # auto-quantize (tpu_auto_quantize) targets the resident
-            # int8 histogram kernels; an un-asked-for discretization
-            # would change streamed numerics — quietly demote. An
-            # EXPLICIT use_quantized_grad is honored: integer level
-            # histograms are what make sharded streaming bit-exact and
-            # engage the packed collective wire.
-            config.use_quantized_grad = False
+        # dataset-level gate: pandas-category / auto-detected
+        # categorical BINS fatal even when categorical_feature is unset
         is_cat = [ds.bin_mappers[f].bin_type == "categorical"
                   for f in ds.used_features]
         _no(any(is_cat), "categorical features")
         self.objective = create_objective(config)
+        # belt-and-braces behind the table's name-based ranking row: a
+        # custom objective OBJECT flagging is_ranking still fatals
         _no(getattr(self.objective, "is_ranking", False),
             "ranking objectives")
 

@@ -282,8 +282,12 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "tpu_rows_per_block": _P("int", 4096),
     "tpu_mesh_shape": _P("str", ""),
     "tpu_double_precision_hist": _P("bool", False),
-    # rows per streamed chunk for two_round out-of-core file loading
-    "tpu_stream_chunk_rows": _P("int", 500000, [], (1000, None)),
+    # rows per streamed chunk for two_round out-of-core file loading.
+    # Small chunks are legitimate (tests force multi-chunk streaming
+    # over small files with a few hundred rows); the floor only guards
+    # against order-of-magnitude typos like 5-for-5M, and the default
+    # is tuned for parser throughput
+    "tpu_stream_chunk_rows": _P("int", 500000, [], (100, None)),
     # leaves expanded per growth round; 1 = exact reference leaf-wise
     # order, larger batches fuse K leaf histograms into one data scan
     "tpu_leaf_batch": _P("int", 32, [], (1, 256)),
@@ -733,7 +737,8 @@ class Config:
     # -- helpers used across the framework ---------------------------------
     @property
     def num_tree_per_iteration(self) -> int:
-        if self.objective in ("multiclass", "multiclassova"):
+        from .capabilities import MULTI_TREE_OBJECTIVES
+        if self.objective in MULTI_TREE_OBJECTIVES:
             return max(1, self.num_class)
         return 1
 
@@ -752,6 +757,40 @@ class Config:
 def coerce_bool(value: Any) -> bool:
     """Public string-aware bool coercion ('false'/'0'/'off' are False)."""
     return _coerce("<bool>", "bool", value)
+
+
+_MISSING = object()
+
+
+def get_param(params: Dict[str, Any], name: str,
+              default: Any = _MISSING) -> Any:
+    """Alias-resolved, type-coerced, bound-checked read of ONE declared
+    parameter from a raw params dict — the sanctioned accessor for
+    dict-shaped reads outside ``Config`` (``Dataset.params``, the
+    launcher's user params). The config-knob-drift checker
+    (``python -m tools.analyze``; docs/static-analysis.md) flags raw
+    ``params.get("tpu_...")`` reads, which re-encode each knob's
+    default/coercion inline and rot when the declaration moves.
+
+    An absent (or ``None``) knob returns the ``_PARAMS``-declared
+    default — pass ``default=`` only to override that (e.g. a
+    caller-level kwarg taking precedence)."""
+    if name not in _PARAMS:
+        log.fatal(f"get_param: {name!r} is not a declared parameter")
+    typ, declared, _aliases, bounds = _PARAMS[name]
+    value = params.get(name, _MISSING)
+    if value is _MISSING:
+        for key, v in params.items():
+            if _ALIASES.get(key, key) == name:
+                value = v
+                break
+    if value is _MISSING or value is None:
+        if default is not _MISSING:
+            return default
+        return list(declared) if isinstance(declared, list) else declared
+    coerced = _coerce(name, typ, value)
+    _check_bounds(name, coerced, bounds)
+    return coerced
 
 
 _TRISTATE_VALUES = {"true": "true", "1": "true", "on": "true",

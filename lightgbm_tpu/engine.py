@@ -13,7 +13,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 from . import callback as callback_mod
-from . import obs
+from . import capabilities, obs
 from .basic import Booster, Dataset
 from .config import Config
 from .utils import log
@@ -403,7 +403,7 @@ def cv(params: Dict[str, Any], train_set: Dataset,
     if metrics is not None:
         params["metric"] = metrics
     cfg = Config(params)
-    if cfg.objective not in ("binary", "multiclass", "multiclassova"):
+    if cfg.objective not in capabilities.STRATIFIABLE_OBJECTIVES:
         stratified = False
     train_set.construct()
 

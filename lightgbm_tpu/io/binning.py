@@ -453,7 +453,7 @@ def mappers_from_params(X, params: Dict, categorical_idx=None,
     by ``Dataset.construct`` and the distributed bin-boundary sync
     (``parallel.launch.sync_bin_mappers``) so both paths can never
     drift on a binning parameter."""
-    from ..config import coerce_bool
+    from ..config import coerce_bool, get_param
     p = params
     return find_bin_mappers(
         X,
@@ -468,7 +468,7 @@ def mappers_from_params(X, params: Dict, categorical_idx=None,
         seed=int(p.get("data_random_seed", 1)),
         forced_bins=(load_forced_bins(str(p["forcedbins_filename"]))
                      if p.get("forcedbins_filename") else None),
-        n_threads=int(p.get("tpu_ingest_threads", 0) or 0))
+        n_threads=get_param(p, "tpu_ingest_threads"))
 
 
 def load_forced_bins(path: str) -> Dict[int, List[float]]:

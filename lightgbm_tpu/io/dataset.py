@@ -305,8 +305,9 @@ class Dataset:
     def _construct_impl(self) -> "Dataset":
         # warm-start: point jax's persistent compile cache BEFORE the
         # first construct-time kernel (the ingest assignment jit)
-        from ..config import setup_compile_cache
-        setup_compile_cache(self.params.get("tpu_compile_cache_dir"))
+        from ..config import get_param, setup_compile_cache
+        setup_compile_cache(get_param(self.params,
+                                      "tpu_compile_cache_dir"))
         if getattr(self, "_stream_path", None):
             return self._construct_streamed()
         if self._finish_pushed():
@@ -392,8 +393,8 @@ class Dataset:
             from ..ops.ingest import device_ingest
             self._ingest = device_ingest(
                 X, self.bin_mappers, self.used_features, dtype,
-                chunk_rows=int(self.params.get("tpu_ingest_chunk_rows",
-                                               262_144)),
+                chunk_rows=get_param(self.params,
+                                     "tpu_ingest_chunk_rows"),
                 emit_transposed=self._want_transposed_ingest(dtype))
             self.binned = None    # host copy materializes lazily
         else:
@@ -422,9 +423,10 @@ class Dataset:
         categorical ids outside the exact float32/int32 window (the
         f32 chunk stream cannot represent them; the host int64 path
         can)."""
-        from ..config import coerce_tristate
+        from .. import capabilities
+        from ..config import coerce_tristate, get_param
         mode = coerce_tristate(
-            self.params.get("tpu_ingest_device", "auto"),
+            get_param(self.params, "tpu_ingest_device"),
             "tpu_ingest_device")
         if mode == "false":
             return False
@@ -433,10 +435,12 @@ class Dataset:
                 or not self.used_features):
             return False
         forced = mode == "true"
-        if coerce_tristate(self.params.get("tpu_streaming", "auto"),
-                           "tpu_streaming") == "true":
-            # forced out-of-core training keeps bins host-resident;
-            # device-resident ingest output would sit orphaned in HBM
+        if capabilities.device_ingest_verdict(self.params) \
+                != capabilities.SUPPORTED:
+            # the engine these params force (the streaming engine's
+            # host-block scan) never adopts device-resident bins — they
+            # would sit orphaned in HBM; the capability table owns the
+            # per-engine adoption verdicts (capabilities.DEVICE_INGEST)
             if forced:
                 log.warning("tpu_ingest_device=true ignored: "
                             "tpu_streaming=true keeps bins "
@@ -478,12 +482,8 @@ class Dataset:
         # slower than host binning), so even forced mode stands down
         import jax
         if jax.device_count() > 1:
-            from ..config import Config
-            tl = "serial"
-            for k, v in self.params.items():
-                if Config.canonical_name(k) == "tree_learner":
-                    tl = str(v).lower()
-            if tl not in ("serial",):
+            tl = str(get_param(self.params, "tree_learner")).lower()
+            if tl != "serial":
                 if forced:
                     log.warning("tpu_ingest_device=true ignored: a "
                                 "distributed tree_learner shards "
@@ -500,13 +500,12 @@ class Dataset:
         Mirrors the engine's Pallas-kernel gate (uint8 bins + TPU +
         tpu_use_pallas) so the host transpose in ``_DeviceData`` never
         runs — the fused kernel writes both layouts per chunk."""
-        from ..config import coerce_bool
+        from ..config import get_param
         if np.dtype(dtype) != np.uint8:
             return False
-        if not coerce_bool(self.params.get("tpu_use_pallas", True)):
+        if not get_param(self.params, "tpu_use_pallas"):
             return False
-        if coerce_bool(self.params.get("tpu_double_precision_hist",
-                                       False)):
+        if get_param(self.params, "tpu_double_precision_hist"):
             return False
         import jax
         return jax.default_backend() == "tpu"
@@ -523,6 +522,7 @@ class Dataset:
             n_rows = self.num_data
         if not used:
             return np.zeros((n_rows, 0), dtype=dtype)
+        from ..config import get_param
         from .binning import _native
         lib = _native()
         dense_fast = (lib is not None and not is_sparse
@@ -582,7 +582,7 @@ class Dataset:
             # with cores (it is per-value binary search — pure CPU)
             n_threads = min(
                 resolve_ingest_threads(
-                    int(self.params.get("tpu_ingest_threads", 0) or 0)),
+                    get_param(self.params, "tpu_ingest_threads")),
                 max(n_rows // 262_144, 1))
             if n_threads > 1:
                 from concurrent.futures import ThreadPoolExecutor
@@ -614,7 +614,7 @@ class Dataset:
         # would dominate
         n_threads = min(
             resolve_ingest_threads(
-                int(self.params.get("tpu_ingest_threads", 0) or 0)),
+                get_param(self.params, "tpu_ingest_threads")),
             len(used))
         if n_threads > 1 and n_rows * len(used) >= 2_000_000:
             from concurrent.futures import ThreadPoolExecutor
@@ -663,14 +663,14 @@ class Dataset:
         the preallocated packed matrix. Peak memory is the BINNED matrix
         (1-2 bytes/cell) + one raw chunk — never the n x F float64 raw
         matrix."""
-        from ..config import coerce_bool
+        from ..config import coerce_bool, get_param
         from .text_loader import iter_text_chunks
         p = self.params
         sp = self._stream_cols
         if coerce_bool(p.get("linear_tree", False)):
             log.fatal("two_round streaming cannot keep the raw feature "
                       "matrix linear_tree needs; load in one round")
-        chunk_rows = int(p.get("tpu_stream_chunk_rows", 500_000))
+        chunk_rows = get_param(p, "tpu_stream_chunk_rows")
         cap = int(p.get("bin_construct_sample_cnt", 200000))
         rng = np.random.default_rng(int(p.get("data_random_seed", 1)))
 
@@ -766,7 +766,7 @@ class Dataset:
                 max_bin_by_feature=p.get("max_bin_by_feature"),
                 seed=int(p.get("data_random_seed", 1)),
                 n_threads=resolve_ingest_threads(
-                    int(p.get("tpu_ingest_threads", 0) or 0)),
+                    get_param(p, "tpu_ingest_threads")),
                 forced_bins=(load_forced_bins(
                     str(p["forcedbins_filename"]))
                     if p.get("forcedbins_filename") else None))

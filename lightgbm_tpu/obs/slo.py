@@ -107,6 +107,7 @@ class SlidingHistogram(_TimeRing):
         self._counts = [[0] * len(b) for _ in range(self.slots)]
 
     def _clear_slot(self, s: int) -> None:
+        """Recycle one ring slot. Caller holds the lock (_slot_for)."""
         self._counts[s] = [0] * len(self.bounds)
 
     def observe(self, v: float, now: Optional[float] = None) -> None:
@@ -180,6 +181,7 @@ class SlidingCounter(_TimeRing):
         self._sums = [0.0] * self.slots
 
     def _clear_slot(self, s: int) -> None:
+        """Recycle one ring slot. Caller holds the lock (_slot_for)."""
         self._sums[s] = 0.0
 
     def inc(self, n: float = 1.0, now: Optional[float] = None) -> None:

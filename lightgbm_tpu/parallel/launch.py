@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from ..config import get_param
 from ..utils import log
 from ..utils.log import LightGBMError
 
@@ -232,7 +233,8 @@ def run_worker(params: Dict, data_fn: Callable[[int, int], ShardSpec],
     # worker appends its rank-tagged snapshot; the train_distributed
     # driver merges them after the gang joins. Best-effort — a full
     # disk must not fail a training run that already succeeded
-    rank_dir = str(params.get("tpu_metrics_rank_dir") or "").strip()
+    rank_dir = str(get_param(params, "tpu_metrics_rank_dir")
+                   or "").strip()
     if rank_dir:
         from ..obs.aggregate import dump_rank_snapshot
         try:
@@ -449,7 +451,7 @@ def train_distributed(params: Dict,
     # remember the staleness budget the poll loop enforces
     hb_timeout = (float(heartbeat_timeout)
                   if heartbeat_timeout is not None
-                  else float(params.get("tpu_heartbeat_timeout", 0)
+                  else float(get_param(params, "tpu_heartbeat_timeout")
                              or 0))
     if 0 < hb_timeout < 3.0:
         # workers stamp at most ~1 Hz (obs.set_heartbeat_file's
@@ -459,7 +461,8 @@ def train_distributed(params: Dict,
         log.warning(f"heartbeat_timeout={hb_timeout:g}s is below the "
                     f"~1 Hz stamp cadence; raising to 3s")
         hb_timeout = 3.0
-    hb_dir = str(params.get("tpu_heartbeat_dir") or "").strip() or None
+    hb_dir = (str(get_param(params, "tpu_heartbeat_dir") or "").strip()
+              or None)
     if hb_timeout > 0 and not hb_dir:
         if ckpt_dir:
             hb_dir = ckpt_dir
@@ -499,7 +502,8 @@ def train_distributed(params: Dict,
     # fresh run claiming a rank-metrics dir: stale rank_*.jsonl from a
     # previous (possibly larger) gang would otherwise merge as live
     # members — yesterday's rank_3 joining today's 2-rank gang view
-    rank_dir = str(params.get("tpu_metrics_rank_dir") or "").strip()
+    rank_dir = str(get_param(params, "tpu_metrics_rank_dir")
+                   or "").strip()
     if rank_dir and resume_from is None:
         import glob as _glob
         import os as _os
@@ -522,8 +526,8 @@ def train_distributed(params: Dict,
     # relaunch env var. Worker-side clearing would race a first gang
     # that never reaches engine.train (a genuine bind-race loss) into
     # skipping the clear entirely.
-    fi_spec = str(params.get("tpu_fault_inject") or "").strip()
-    fault_marker_dir = (str(params.get("tpu_fault_marker") or "")
+    fi_spec = str(get_param(params, "tpu_fault_inject") or "").strip()
+    fault_marker_dir = (str(get_param(params, "tpu_fault_marker") or "")
                         or ckpt_dir)
     if fi_spec and fault_marker_dir and resume_from is None:
         from ..recovery.faults import clear_fault_markers
@@ -614,7 +618,8 @@ def train_distributed(params: Dict,
     # gang-wide metrics view: merge the per-rank snapshots the workers
     # dumped (counters sum, gauges latest, histograms bucket-add) into
     # <dir>/merged.jsonl and surface the straggler gauge on the driver
-    rank_dir = str(params.get("tpu_metrics_rank_dir") or "").strip()
+    rank_dir = str(get_param(params, "tpu_metrics_rank_dir")
+                   or "").strip()
     if rank_dir:
         from ..obs.aggregate import merge_rank_dir
         try:

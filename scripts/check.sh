@@ -69,13 +69,24 @@ CHAOS_SMOKE=1
 JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
 python benchmarks/chaos_bench.py --smoke || CHAOS_SMOKE=0
 
+# static analysis (docs/static-analysis.md): the five drift linters —
+# capability-gate / config-knobs / obs-names / collective-safety /
+# lock-discipline — must report ZERO findings. The count rides the obs
+# line (lint_findings=) so scripts/obs_trend.py fails absolutely on
+# lint_findings>0, and a non-zero count exits 6 below. A crash of the
+# analyzer itself (no count file) records -1 — also a failure.
+LINT_COUNT_FILE=/tmp/_check_lint_count
+rm -f "$LINT_COUNT_FILE"
+python -m tools.analyze --emit-count "$LINT_COUNT_FILE" || true
+LINT_FINDINGS=$(cat "$LINT_COUNT_FILE" 2>/dev/null || echo -1)
+
 # machine-readable obs line appended next to the plain timing line:
 # dots/seconds from this run plus compile count and peak-HBM estimate
 # read back from the snapshot. A malformed dump FAILS the gate — a
 # check that silently skips its own telemetry is how telemetry rots.
-python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" <<'PY' >> scripts/check_timings.log
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" <<'PY' >> scripts/check_timings.log
 import json, sys, time
-path, mode, dots, secs, rev, stream_ok, chaos_ok = sys.argv[1:8]
+path, mode, dots, secs, rev, stream_ok, chaos_ok, lint = sys.argv[1:9]
 try:
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     snap = json.loads(lines[-1])
@@ -111,6 +122,9 @@ print("obs " + json.dumps({
     "stream_dryrun": int(stream_ok),
     # kill + resume + hot-swap loop (benchmarks/chaos_bench.py --smoke)
     "chaos_smoke": int(chaos_ok),
+    # drift-linter findings (python -m tools.analyze; -1 = analyzer
+    # crashed). obs_trend.py fails absolutely on anything but 0
+    "lint_findings": int(lint),
 }))
 PY
 
@@ -121,6 +135,11 @@ fi
 if [[ "$CHAOS_SMOKE" != 1 ]]; then
   echo "check.sh: chaos smoke FAILED (kill+resume+swap; status logged)"
   exit 5
+fi
+if [[ "$LINT_FINDINGS" != 0 ]]; then
+  echo "check.sh: static analysis FAILED ($LINT_FINDINGS finding(s);" \
+       "run python -m tools.analyze — docs/static-analysis.md)"
+  exit 6
 fi
 
 # perf-regression sentinel (CHECK_TREND=1 to enforce): compare the obs

@@ -22,7 +22,12 @@ regressed past its threshold —
   single-shard streaming or crashed;
 - ``chaos_smoke`` == 0 in the NEWEST run (absolute, like
   stream_dryrun): the kill + resume + hot-swap chaos smoke check.sh
-  runs lost bit-equality, dropped a request, or crashed.
+  runs lost bit-equality, dropped a request, or crashed;
+- ``lint_findings`` != 0 in the NEWEST run (absolute): the static
+  analysis suite (``python -m tools.analyze``;
+  docs/static-analysis.md) reported drift findings — or crashed
+  (recorded as -1). A drifted gate literal / raw knob read /
+  branch-wrapped collective is broken NOW, whatever the history says.
 
 No (or not enough) history exits 0 — the first run after a wipe stays
 green. A signal missing from either side of the comparison is skipped
@@ -132,6 +137,16 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
             "chaos smoke FAILED (chaos_smoke=0): kill + resume + "
             "hot-swap lost bit-equality or crashed "
             "(benchmarks/chaos_bench.py --smoke)")
+    # static analysis is absolute the same way: findings are drift
+    # bugs NOW (gate literal outside the capability table, raw knob
+    # read, collective inside a lax.switch branch...), and -1 means
+    # the analyzer itself crashed
+    lint = _num(newest, "lint_findings")
+    if lint is not None and lint != 0.0:
+        failures.append(
+            f"static analysis FAILED (lint_findings={lint:g}): "
+            f"run `python -m tools.analyze` and fix (or explicitly "
+            f"allowlist) every finding — docs/static-analysis.md")
     mode = newest.get("mode")
     # rejected entries (previous sentinel failures) never become
     # baseline — a persistent regression re-run N times must keep

@@ -174,3 +174,31 @@ def test_stream_dryrun_failure_fails_even_without_history(tmp_path):
         dict(json.loads(_obs_line()[len("obs "):]), stream_dryrun=1))
     rc, out = _run(tmp_path, [_obs_line() for _ in range(4)] + [ok])
     assert rc == 0, out
+
+
+def test_lint_findings_fail_even_without_history(tmp_path):
+    """The static-analysis pin is ABSOLUTE like stream_dryrun/
+    chaos_smoke: lint_findings>0 (drift findings) or -1 (the analyzer
+    crashed) in the newest entry fails the sentinel with or without a
+    baseline; 0 — or an old line without the key — stays green."""
+    def with_lint(v):
+        return "obs " + json.dumps(
+            dict(json.loads(_obs_line()[len("obs "):]),
+                 lint_findings=v))
+    # findings, no history at all
+    rc, out = _run(tmp_path, [with_lint(3)])
+    assert rc == 1, out
+    assert "lint_findings" in out
+    # an analyzer crash (-1) is also a failure
+    rc, out = _run(tmp_path, [with_lint(-1)])
+    assert rc == 1, out
+    # with healthy history it still fails
+    rc, out = _run(tmp_path, [_obs_line() for _ in range(4)]
+                   + [with_lint(2)])
+    assert rc == 1, out
+    # a clean run — and a pre-suite line without the key — stay green
+    rc, out = _run(tmp_path, [_obs_line() for _ in range(4)]
+                   + [with_lint(0)])
+    assert rc == 0, out
+    rc, out = _run(tmp_path, [_obs_line()])
+    assert rc == 0, out

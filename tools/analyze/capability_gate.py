@@ -1,0 +1,100 @@
+"""Checker 1: capability-gate lint — eligibility literals belong in
+lightgbm_tpu/capabilities.py.
+
+The drift class this kills: PRs 5/10/12 each fixed a bug where one
+routing site's inline list (``config.objective in ("binary", ...)``,
+``tree_learner in ("serial", "data")``) fell out of sync with another
+site's copy. After the PR-14 refactor every such judgment reads the ONE
+capability table, so ANY membership test of a dispatch attribute
+(:data:`GATE_ATTRS`) against a literal string container outside
+capabilities.py is a regression.
+
+Flagged shape::
+
+    <expr>.objective in ("binary", "regression")      # and not-in
+    config.tree_learner not in ("serial", "data")
+
+Key: ``<attr>@<enclosing-qualname>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, SourceSet, attr_chain
+
+NAME = "capability-gate"
+
+# config attributes whose value space routes between engines/learners:
+# an inline literal membership test over one of these IS an eligibility
+# list (the thing the capability table centralizes)
+GATE_ATTRS = ("objective", "boosting", "tree_learner",
+              "data_sample_strategy")
+
+# the table itself (and its tests) legitimately hold the literals
+EXEMPT_FILES = ("lightgbm_tpu/capabilities.py",)
+
+
+def _gate_attr(node: ast.AST) -> str:
+    """The GATE_ATTRS name this expression reads, "" otherwise.
+    Unwraps str()/getattr-style wrappers: ``str(config.objective)``."""
+    if isinstance(node, ast.Call) and node.args:
+        # str(config.objective), some_fn(config.boosting)
+        return _gate_attr(node.args[0])
+    chain = attr_chain(node)
+    if not chain:
+        return ""
+    leaf = chain.rsplit(".", 1)[-1]
+    return leaf if leaf in GATE_ATTRS else ""
+
+
+def _is_str_container(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return bool(node.elts) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts)
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.scope: List[str] = ["<module>"]
+        self.findings: List[Finding] = []
+
+    def _visit_scope(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_ClassDef = _visit_scope
+
+    def visit_Compare(self, node: ast.Compare):
+        for op, comparator in zip(node.ops, node.comparators):
+            if not isinstance(op, (ast.In, ast.NotIn)):
+                continue
+            if not _is_str_container(comparator):
+                continue
+            attr = _gate_attr(node.left)
+            if attr:
+                qual = self.scope[-1]
+                self.findings.append(Finding(
+                    NAME, self.rel, node.lineno, f"{attr}@{qual}",
+                    f"inline eligibility literal: `{attr}` tested "
+                    f"against a literal container in `{qual}` — move "
+                    f"the list into lightgbm_tpu/capabilities.py and "
+                    f"test against the named constant"))
+        self.generic_visit(node)
+
+
+def check(sources: SourceSet) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, tree in sources.items():
+        if rel in EXEMPT_FILES:
+            continue
+        v = _Visitor(rel)
+        v.visit(tree)
+        out.extend(v.findings)
+    return out

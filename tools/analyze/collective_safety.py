@@ -1,0 +1,264 @@
+"""Checker 4: collective-safety — the PR 12 deadlock class.
+
+Invariant (docs/perf.md "Streamed × sharded"; learner/serial.py's span
+switch): cross-device collectives (``psum`` / ``psum_scatter`` /
+``all_gather`` / the shared ``hist_allreduce`` wire) must stay OUTSIDE
+
+- ``lax.switch`` / ``lax.cond`` branch functions: under SPMD a branch
+  index that is not provably uniform across ranks lets different ranks
+  enter different branches, and a collective inside one branch then
+  waits forever for peers executing the other — the deadlock PR 12
+  debugged. 71 collective-reachable call sites across 10 files were
+  previously guarded only by reviewer memory.
+- rank-divergent Python conditionals: ``if process_index() == 0: ...``
+  (or an ``if`` over a ``rank``-named value) around a collective
+  diverges the gang at trace time.
+
+Detection: per module, a call graph over locally-defined functions
+(including nested defs and lambdas) is fixpointed into the set of
+*collective-reaching* functions. A reference to such a function in a
+``lax.switch``/``lax.cond`` branch position — directly, or through a
+local ``branches``-list variable (``branches.append(f)`` /
+``branches = [f, g]``) — is a finding, as is a collective-reaching
+call lexically inside a rank-divergent ``if``.
+
+The ONE intentional exception (the packed-wire fallback in
+learner/collective.py, whose cond predicate is itself a psum output and
+therefore mesh-uniform by construction) lives in the allowlist with
+that reasoning spelled out.
+
+Keys: ``branch:<function>@<switch-site-function>``,
+``rank-if:<collective>@<enclosing-function>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, SourceSet, call_name
+
+NAME = "collective-safety"
+
+COLLECTIVES = {"psum", "psum_scatter", "all_gather", "pmean",
+               "all_to_all", "hist_allreduce"}
+RANK_NAMES = {"process_index", "axis_index", "rank", "local_rank",
+              "proc_index"}
+
+
+class _FnInfo:
+    def __init__(self, qual: str, node: ast.AST):
+        self.qual = qual
+        self.node = node
+        self.calls: Set[str] = set()        # local function names called
+        self.collective: bool = False       # directly calls a collective
+        self.nested: Set[str] = set()       # defs nested inside (any depth)
+
+
+def _walk_pruned(node: ast.AST):
+    """ast.walk over one function's OWN body — does not descend into
+    nested function definitions (their calls are their own)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _collect_functions(tree: ast.Module) -> Dict[str, _FnInfo]:
+    """name -> info for every def; nested defs register under their
+    bare name AND their dotted qualname (branch references use the
+    bare name)."""
+    fns: Dict[str, _FnInfo] = {}
+
+    def walk_fn(node, qual: str) -> _FnInfo:
+        info = _FnInfo(qual, node)
+        for n in _walk_pruned(node):
+            if isinstance(n, ast.Call):
+                cn = call_name(n)
+                if cn in COLLECTIVES:
+                    info.collective = True
+                elif cn:
+                    info.calls.add(cn)
+        for n in ast.walk(node):
+            if n is not node and isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.nested.add(n.name)
+        return info
+
+    def visit(node, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                info = walk_fn(child, qual)
+                # nested defs shadow same-named outer ones per scope;
+                # over-approximate by keeping the first registration
+                fns.setdefault(child.name, info)
+                fns.setdefault(qual, info)
+                visit(child, qual + ".")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return fns
+
+
+def _fixpoint(fns: Dict[str, _FnInfo]) -> Set[str]:
+    """Names of collective-reaching functions (direct or via local
+    calls)."""
+    reaching = {n for n, i in fns.items() if i.collective}
+    changed = True
+    while changed:
+        changed = False
+        for n, i in fns.items():
+            if n not in reaching and (i.calls & reaching):
+                reaching.add(n)
+                changed = True
+    return reaching
+
+
+def _branch_refs(arg: ast.AST,
+                 list_vars: Dict[str, Set[str]]) -> Set[str]:
+    """Function names referenced by one switch/cond branch operand."""
+    out: Set[str] = set()
+    if isinstance(arg, ast.Name):
+        out.add(arg.id)
+        out |= list_vars.get(arg.id, set())
+    elif isinstance(arg, (ast.Tuple, ast.List)):
+        for e in arg.elts:
+            out |= _branch_refs(e, list_vars)
+    elif isinstance(arg, ast.Lambda):
+        for n in ast.walk(arg.body):
+            if isinstance(n, ast.Call):
+                cn = call_name(n)
+                if cn:
+                    out.add(cn)
+                if cn in COLLECTIVES:
+                    out.add(cn)
+    return out
+
+
+def _rank_divergent(test: ast.AST) -> Optional[str]:
+    """Name evidence that an `if` test reads a rank identity."""
+    for n in ast.walk(test):
+        name = ""
+        if isinstance(n, ast.Call):
+            name = call_name(n)
+        elif isinstance(n, ast.Name):
+            name = n.id
+        elif isinstance(n, ast.Attribute):
+            name = n.attr
+        if name in RANK_NAMES:
+            return name
+    return None
+
+
+class _ModuleChecker(ast.NodeVisitor):
+    def __init__(self, rel: str, fns: Dict[str, _FnInfo],
+                 reaching: Set[str]):
+        self.rel = rel
+        self.fns = fns
+        self.reaching = reaching
+        self.scope: List[str] = ["<module>"]
+        # per enclosing-function map of list-var -> appended fn names
+        self.list_vars: Dict[str, Set[str]] = {}
+        self.findings: List[Finding] = []
+
+    def _enter(self, node):
+        self.scope.append(node.name)
+        saved = self.list_vars
+        self.list_vars = dict(saved)
+        self.generic_visit(node)
+        self.list_vars = saved
+        self.scope.pop()
+
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+
+    def visit_Assign(self, node: ast.Assign):
+        # branches = [f, g, ...]
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            refs = {e.id for e in node.value.elts
+                    if isinstance(e, ast.Name)}
+            if refs:
+                self.list_vars[node.targets[0].id] = refs
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn_name = call_name(node)
+        # branches.append(f) / branches.append(mk(x))
+        if (fn_name == "append" and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.args):
+            var = node.func.value.id
+            refs = self.list_vars.setdefault(var, set())
+            a = node.args[0]
+            if isinstance(a, ast.Name):
+                refs.add(a.id)
+            elif isinstance(a, ast.Call):
+                # factory pattern: append(mk(S)) — the factory's
+                # RETURNED closure is what runs; over-approximate with
+                # the factory's own collective reach (its nested defs
+                # register under their bare names)
+                cn = call_name(a)
+                if cn:
+                    refs.add(cn)
+        if fn_name in ("switch", "cond"):
+            branch_args = node.args[1:]
+            for arg in branch_args:
+                for ref in sorted(_branch_refs(arg, self.list_vars)):
+                    # a factory reference (branches.append(mk(S)))
+                    # stands in for the closures defined inside it
+                    expanded = {ref} | (self.fns[ref].nested
+                                        if ref in self.fns else set())
+                    if any(r in self.reaching or r in COLLECTIVES
+                           for r in expanded):
+                        self.findings.append(Finding(
+                            NAME, self.rel, node.lineno,
+                            f"branch:{ref}@{self.scope[-1]}",
+                            f"collective-reaching function `{ref}` is "
+                            f"a lax.{fn_name} branch in "
+                            f"`{self.scope[-1]}` — a rank-divergent "
+                            f"branch index deadlocks the gang "
+                            f"(PR 12 class); hoist the collective "
+                            f"out of the branch"))
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If):
+        ev = _rank_divergent(node.test)
+        if ev:
+            # BOTH suites: `if rank == 0: log() else: psum(...)` is
+            # just as divergent as the collective sitting in the body
+            # (elif chains are Ifs nested in orelse and are covered)
+            for part in node.body + node.orelse:
+                for n in ast.walk(part):
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                        continue
+                    if isinstance(n, ast.Call):
+                        cn = call_name(n)
+                        if cn in COLLECTIVES or cn in self.reaching:
+                            self.findings.append(Finding(
+                                NAME, self.rel, n.lineno,
+                                f"rank-if:{cn}@{self.scope[-1]}",
+                                f"collective `{cn}` inside an "
+                                f"`if {ev} ...` block in "
+                                f"`{self.scope[-1]}` — ranks diverge "
+                                f"and the collective waits forever"))
+        self.generic_visit(node)
+
+
+def check(sources: SourceSet) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, tree in sources.items():
+        fns = _collect_functions(tree)
+        reaching = _fixpoint(fns)
+        mc = _ModuleChecker(rel, fns, reaching)
+        mc.visit(tree)
+        out.extend(mc.findings)
+    return out

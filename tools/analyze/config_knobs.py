@@ -1,0 +1,131 @@
+"""Checker 2: config-knob drift — every ``tpu_*`` knob is declared
+once, read through the declared accessors, and documented.
+
+Three rules:
+
+- **raw-read** — ``<dict>.get("tpu_...")`` anywhere outside config.py
+  re-encodes the knob's default and coercion inline (the 15 raw reads
+  in parallel/launch.py and io/dataset.py each carried their own copy
+  of the default before PR 14). Sanctioned reads: a resolved ``Config``
+  attribute, ``getattr(cfg, "tpu_...")``, or
+  :func:`lightgbm_tpu.config.get_param` for dict-shaped params.
+- **undeclared** — a ``tpu_*`` name read via ``get_param``/``getattr``/
+  ``.get`` (or written via ``params["tpu_..."] = ...``) that is not a
+  ``_PARAMS`` key in config.py: a typo'd or never-registered knob
+  silently does nothing.
+- **undocumented** — a declared ``tpu_*`` knob that appears in neither
+  README.md nor any docs/*.md: users cannot discover it.
+
+Keys: ``raw-read:<knob>``, ``undeclared:<name>``,
+``undocumented:<knob>``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, SourceSet, call_name, const_str
+
+NAME = "config-knobs"
+
+CONFIG_FILE = "lightgbm_tpu/config.py"
+_KNOB_RE = re.compile(r"^tpu_[a-z0-9_]+$")
+
+
+def declared_knobs(sources: SourceSet) -> Set[str]:
+    """_PARAMS keys from config.py's AST (all of them; the doc rule
+    filters to tpu_*)."""
+    tree = sources.trees.get(CONFIG_FILE)
+    if tree is None:
+        return set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            is_params = any(isinstance(t, ast.Name) and t.id == "_PARAMS"
+                            for t in node.targets)
+        elif isinstance(node, ast.AnnAssign):
+            is_params = (isinstance(node.target, ast.Name)
+                         and node.target.id == "_PARAMS")
+        else:
+            continue
+        if is_params and isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return set()
+
+
+def _doc_text(root: str) -> str:
+    chunks = []
+    for rel in ["README.md"] + sorted(
+            os.path.join("docs", f)
+            for f in (os.listdir(os.path.join(root, "docs"))
+                      if os.path.isdir(os.path.join(root, "docs"))
+                      else [])
+            if f.endswith(".md")):
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            chunks.append(open(path, encoding="utf-8").read())
+    return "\n".join(chunks)
+
+
+def _knob_reads(tree: ast.Module) -> List[Tuple[str, int, str]]:
+    """(knob, line, kind) for every tpu_* read/write in one module.
+    kind: "dict-get" (the banned shape), "accessor" (get_param /
+    getattr / subscript-store — fine, but must name a declared knob)."""
+    out: List[Tuple[str, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = call_name(node)
+            if fn == "get" and isinstance(node.func, ast.Attribute) \
+                    and node.args:
+                s = const_str(node.args[0])
+                if s and _KNOB_RE.match(s):
+                    out.append((s, node.lineno, "dict-get"))
+            elif fn in ("get_param", "getattr") and len(node.args) >= 2:
+                s = const_str(node.args[1])
+                if s and _KNOB_RE.match(s):
+                    out.append((s, node.lineno, "accessor"))
+        elif isinstance(node, ast.Subscript):
+            s = const_str(node.slice)
+            if s and _KNOB_RE.match(s):
+                out.append((s, node.lineno, "accessor"))
+        elif isinstance(node, ast.Attribute):
+            if _KNOB_RE.match(node.attr):
+                out.append((node.attr, node.lineno, "accessor"))
+    return out
+
+
+def check(sources: SourceSet) -> List[Finding]:
+    declared = declared_knobs(sources)
+    docs = _doc_text(sources.root)
+    out: List[Finding] = []
+    seen_undeclared: Set[Tuple[str, str]] = set()
+    for rel, tree in sources.items():
+        if rel == CONFIG_FILE:
+            continue
+        for knob, line, kind in _knob_reads(tree):
+            if kind == "dict-get":
+                out.append(Finding(
+                    NAME, rel, line, f"raw-read:{knob}",
+                    f'raw params.get("{knob}") — route through '
+                    f"Config / config.get_param so the declared "
+                    f"default, aliasing and coercion apply "
+                    f"(docs/static-analysis.md)"))
+            if knob not in declared and (rel, knob) not in seen_undeclared:
+                seen_undeclared.add((rel, knob))
+                out.append(Finding(
+                    NAME, rel, line, f"undeclared:{knob}",
+                    f'"{knob}" is not declared in config.py _PARAMS '
+                    f"— a typo'd or unregistered knob silently does "
+                    f"nothing"))
+    tpu_declared = sorted(k for k in declared if k.startswith("tpu_"))
+    for knob in tpu_declared:
+        if knob not in docs:
+            out.append(Finding(
+                NAME, CONFIG_FILE, 0, f"undocumented:{knob}",
+                f'declared knob "{knob}" appears in neither README.md '
+                f"nor docs/*.md — document it where its subsystem "
+                f"lives"))
+    return out

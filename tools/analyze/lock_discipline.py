@@ -1,0 +1,155 @@
+"""Checker 5: lock-discipline — the obs registry and SLO tracker are
+scraped from server threads while training/serving threads write them;
+shared state mutated outside ``with self._lock`` is a data race.
+
+Scope: classes under ``lightgbm_tpu/obs/`` that create a
+``self._lock`` in ``__init__`` (MetricsRegistry, the metric types, the
+time-ring SLIs, SloTracker). *Shared state* is every ``self.<attr>``
+assigned in ``__init__`` (own or same-module ancestor). A mutation —
+assign / augassign / ``del`` / a mutating method call
+(``.append``/``.add``/``.clear``/...) on such an attribute, or through
+a subscript of it — outside a lexical ``with self._lock`` block and
+outside ``__init__`` is a finding.
+
+Exemption convention (repo-native, already used by ``_TimeRing``):
+a method whose docstring says "caller holds the lock" declares itself
+a lock-held helper — callers take the lock, the checker trusts the
+declaration (and a reviewer can grep the phrase). Anything else
+intentional goes in the allowlist with a reason.
+
+Key: ``<Class>.<method>:<attr>``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, SourceSet
+
+NAME = "lock-discipline"
+
+SCOPE_PREFIX = "lightgbm_tpu/obs/"
+LOCK_ATTR = "_lock"
+_HELD_RE = re.compile(r"caller holds the lock", re.IGNORECASE)
+MUTATORS = {"append", "add", "clear", "pop", "popitem", "update",
+            "extend", "remove", "insert", "discard", "setdefault"}
+
+
+def _init_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self.<attr> names assigned anywhere in this class's __init__."""
+    out: Set[str] = set()
+    for item in cls.body:
+        if isinstance(item, ast.FunctionDef) and item.name == "__init__":
+            for n in ast.walk(item):
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, ast.Store)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"):
+                    out.add(n.attr)
+    return out
+
+
+def _self_attr_of(node: ast.AST) -> Optional[str]:
+    """self.<attr> at the ROOT of a (possibly subscripted) target."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lock_with(item: ast.withitem) -> bool:
+    ctx = item.context_expr
+    return (isinstance(ctx, ast.Attribute) and ctx.attr == LOCK_ATTR
+            and isinstance(ctx.value, ast.Name)
+            and ctx.value.id == "self")
+
+
+def _mutations(node: ast.AST, shared: Set[str], under_lock: bool,
+               hits: List):
+    """Recursive walk tracking `with self._lock` lexical scope."""
+    if isinstance(node, ast.With):
+        locked = under_lock or any(_is_lock_with(i) for i in node.items)
+        for child in node.body:
+            _mutations(child, shared, locked, hits)
+        return
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return      # nested callables are their own discipline problem
+    attr = None
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            a = _self_attr_of(t)
+            if a and a in shared and a != LOCK_ATTR and not under_lock:
+                hits.append((node.lineno, a))
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            a = _self_attr_of(t)
+            if a and a in shared and not under_lock:
+                hits.append((node.lineno, a))
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+            a = _self_attr_of(f.value)
+            if a and a in shared and not under_lock:
+                hits.append((node.lineno, a))
+    for child in ast.iter_child_nodes(node):
+        _mutations(child, shared, under_lock, hits)
+    return attr
+
+
+def check(sources: SourceSet) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, tree in sources.items():
+        if not rel.startswith(SCOPE_PREFIX):
+            continue
+        classes: Dict[str, ast.ClassDef] = {
+            n.name: n for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef)}
+        # same-module inheritance: attrs + the lock may come from a base
+        attrs_of: Dict[str, Set[str]] = {}
+
+        def resolved_attrs(name: str, seen=()) -> Set[str]:
+            if name in attrs_of:
+                return attrs_of[name]
+            cls = classes.get(name)
+            if cls is None or name in seen:
+                return set()
+            s = _init_attrs(cls)
+            for b in cls.bases:
+                if isinstance(b, ast.Name):
+                    s |= resolved_attrs(b.id, seen + (name,))
+            attrs_of[name] = s
+            return s
+
+        for cname, cls in classes.items():
+            shared = resolved_attrs(cname)
+            if LOCK_ATTR not in shared:
+                continue
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name == "__init__":
+                    continue
+                doc = ast.get_docstring(item) or ""
+                if _HELD_RE.search(doc):
+                    continue    # declared lock-held helper
+                hits: List = []
+                for stmt in item.body:
+                    _mutations(stmt, shared, False, hits)
+                for line, attr in hits:
+                    out.append(Finding(
+                        NAME, rel, line,
+                        f"{cname}.{item.name}:{attr}",
+                        f"`self.{attr}` mutated in {cname}."
+                        f"{item.name} outside `with self._lock` — "
+                        f"scrape threads race this state; take the "
+                        f"lock or declare the method "
+                        f'"caller holds the lock"'))
+    return out
