@@ -1,0 +1,321 @@
+"""Serving-service load harness: sustained RPS at a p99 target.
+
+Drives the async serving stack end to end — N client threads submit
+concurrent requests for M tenants' models through one
+``PredictService`` (micro-batching queue + LRU registry + optional
+tree-sharded predict) — and reports the SLO-shaped numbers ROADMAP
+item 1 asks for: sustained requests/sec, predict p50/p99 against a
+target, live queue depth, batch fill ratio, and cache hit/eviction
+accounting. Exit status is the SLO verdict: nonzero when the measured
+p99 misses ``--p99-target-ms`` or any request dropped.
+
+Run:
+  python benchmarks/serve_bench.py                      # 4 models,
+                                                        # 8 clients, 10 s
+  python benchmarks/serve_bench.py --models 8 --clients 16 --seconds 30
+  python benchmarks/serve_bench.py --cache-models 2     # force LRU churn
+  python benchmarks/serve_bench.py --smoke              # CI gate:
+    sub-minute — concurrent clients, one LRU eviction, one mid-traffic
+    hot-swap; exit 0 iff zero requests dropped AND zero warm-path
+    compiles (scripts/check.sh appends the result as serve_smoke= on
+    the obs line; scripts/obs_trend.py fails ABSOLUTELY on
+    serve_smoke=0)
+
+Each line is one JSON record; the final line aggregates.
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _data(n, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, rounds, leaves, seed=0):
+    import lightgbm_tpu as lgb
+    return lgb.train({"objective": "binary", "num_leaves": leaves,
+                      "verbosity": -1, "seed": seed},
+                     lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def _client(svc, model_ids, X_pool, batch, stop, lat, drops, seed):
+    rng = np.random.default_rng(seed)
+    while not stop.is_set():
+        mid = model_ids[int(rng.integers(0, len(model_ids)))]
+        rows = X_pool[rng.integers(0, len(X_pool), size=batch)]
+        t0 = time.perf_counter()
+        try:
+            svc.predict(mid, rows, timeout=30.0)
+            lat.append(time.perf_counter() - t0)
+        except Exception:
+            drops.append(mid)
+
+
+def _quantile(sorted_lat, q):
+    if not sorted_lat:
+        return None
+    i = min(int(q * len(sorted_lat)), len(sorted_lat) - 1)
+    return sorted_lat[i]
+
+
+# ---------------------------------------------------------------------------
+# full load run
+# ---------------------------------------------------------------------------
+def run_load(args):
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import slo as _slo
+    from lightgbm_tpu.serve import PredictService
+    obs.enable(metrics=True, slo=True)
+    X, y = _data(args.rows)
+    svc = PredictService({
+        "tpu_serve_batch_budget_ms": args.budget_ms,
+        "tpu_serve_max_batch_rows": args.max_batch_rows,
+        "tpu_serve_cache_models": args.cache_models,
+        "tpu_serve_shard_trees": args.shard_trees,
+        # expose GET /metrics (+ /readyz) mid-run so slo.queue_depth /
+        # serve.cache_hits can be scraped live while the load runs
+        "tpu_metrics_port": args.metrics_port,
+    })
+    model_ids = []
+    for m in range(args.models):
+        bst = _train(X, y, args.rounds, args.leaves, seed=m)
+        mid = f"tenant{m}"
+        svc.add_model(mid, bst)
+        svc.warmup(mid, X[:1])
+        model_ids.append(mid)
+    print(json.dumps({"models": args.models, "warmed": True}),
+          flush=True)
+
+    lat, drops = [], []
+    stop = threading.Event()
+    threads = [threading.Thread(
+        target=_client, args=(svc, model_ids, X, args.batch, stop, lat,
+                              drops, 100 + i), daemon=True)
+        for i in range(args.clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    depth_max = 0
+    while time.time() - t0 < args.seconds:
+        depth_max = max(depth_max, svc.queue.depth())
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.time() - t0
+
+    slat = sorted(lat)
+    p50, p99 = _quantile(slat, 0.50), _quantile(slat, 0.99)
+    rps = len(lat) / elapsed
+    reg = obs.registry()
+
+    def metric(name):
+        m = reg.get(name)
+        return getattr(m, "value", None)
+
+    slis = (_slo.tracker().compute() if _slo.tracker() else {})
+    met = (p99 is not None and p99 * 1000.0 <= args.p99_target_ms
+           and not drops)
+    obs.set_gauge("bench.serve_rps", round(rps, 1), force=True)
+    obs.set_gauge("bench.serve_p99_ms",
+                  round((p99 or 0.0) * 1000.0, 3), force=True)
+    rec = {
+        "clients": args.clients, "models": args.models,
+        "seconds": round(elapsed, 1), "requests": len(lat),
+        "rps": round(rps, 1),
+        "p50_ms": round((p50 or 0.0) * 1e3, 2),
+        "p99_ms": round((p99 or 0.0) * 1e3, 2),
+        "p99_target_ms": args.p99_target_ms, "met_target": bool(met),
+        "dropped": len(drops),
+        "queue_depth_max": depth_max,
+        "slo_queue_depth": slis.get("slo.queue_depth"),
+        "dispatches": metric("serve.dispatches"),
+        "coalesced_requests": metric("serve.coalesced_requests"),
+        "batch_fill_ratio": metric("serve.batch_fill_ratio"),
+        "cache_hits": metric("serve.cache_hits"),
+        "evictions": metric("serve.evictions"),
+    }
+    svc.close()
+    if args.metrics_json:
+        obs.dump_jsonl(args.metrics_json)
+    print(json.dumps(rec), flush=True)
+    return 0 if met else 1
+
+
+# ---------------------------------------------------------------------------
+# CI smoke: clients + one eviction + one mid-traffic swap, hard asserts
+# ---------------------------------------------------------------------------
+def _publish(staging, pub):
+    """Land a pre-trained checkpoint mid-traffic: payloads first, the
+    ``latest.rank*`` pointers last (the order the atomic publisher
+    guarantees)."""
+    names = sorted(os.listdir(staging))
+    pointers = [n for n in names if n.startswith("latest.")]
+    for name in names:
+        if name not in pointers:
+            shutil.copy(os.path.join(staging, name),
+                        os.path.join(pub, name))
+    for name in pointers:
+        shutil.copy(os.path.join(staging, name),
+                    os.path.join(pub, name))
+
+
+def run_smoke():
+    """Sub-minute serving gate, exit nonzero on ANY broken invariant:
+
+    1. N concurrent clients over 2 tenants with a 1-model LRU — every
+       request resolves (ZERO drops) through eviction churn;
+    2. a checkpoint published MID-TRAFFIC hot-swaps in (watcher swap
+       under the swap lock) without dropping or corrupting a request;
+    3. the whole loaded phase — coalescing, evictions, re-admissions,
+       the swap — compiles ZERO XLA programs after warmup
+       (CompileWatch);
+    4. the live plane is real: slo.queue_depth sampled, cache
+       hits/evictions counted, heartbeat.serve stamped.
+    """
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.utils.debug import CompileWatch
+    t0 = time.time()
+    obs.enable(metrics=True, slo=True)
+    X, y = _data(4_000, seed=1)
+    rounds, leaves = 4, 8
+    bA = _train(X, y, rounds, leaves, seed=0)
+    bB = _train(X, y, rounds, leaves, seed=1)
+    # v2 of tenant A, published mid-traffic below (pre-trained so the
+    # CompileWatch window sees serving compiles only). Same tree count
+    # and leaf cap as bA — the swap must reuse every compiled program —
+    # but a different learning rate, so its PREDICTIONS visibly differ
+    # and the post-swap equality assert below has teeth
+    staging = tempfile.mkdtemp(prefix="lgbm_serve_stage_")
+    pub = tempfile.mkdtemp(prefix="lgbm_serve_pub_")
+    try:
+        return _run_smoke_body(lgb, obs, CompileWatch, t0, X, y,
+                               rounds, leaves, bA, bB, staging, pub)
+    finally:
+        # check.sh runs this every invocation: leaked checkpoint dirs
+        # would accumulate unbounded /tmp disk across CI runs
+        shutil.rmtree(staging, ignore_errors=True)
+        shutil.rmtree(pub, ignore_errors=True)
+
+
+def _run_smoke_body(lgb, obs, CompileWatch, t0, X, y, rounds, leaves,
+                    bA, bB, staging, pub):
+    v2 = lgb.train({"objective": "binary", "num_leaves": leaves,
+                    "verbosity": -1, "learning_rate": 0.05,
+                    "checkpoint_dir": staging,
+                    "checkpoint_interval": rounds},
+                   lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+    svc = lgb.PredictService({"tpu_serve_batch_budget_ms": 2.0,
+                              "tpu_serve_max_batch_rows": 512,
+                              "tpu_serve_cache_models": 1,
+                              "tpu_serve_shard_trees": "false"})
+    svc.add_model("a", bA, watch_dir=pub, watch_interval=0.0)
+    svc.add_model("b", bB)
+    svc.warmup("a", X[:1])
+    svc.warmup("b", X[:1])
+    Xq = X[:64]
+    pre_swap = bA.predict(Xq)
+
+    lat, drops = [], []
+    stop = threading.Event()
+    threads = [threading.Thread(
+        target=_client, args=(svc, ["a", "b"], X, 64, stop, lat, drops,
+                              100 + i), daemon=True)
+        for i in range(4)]
+    depth_max = 0
+    with CompileWatch("serve-smoke") as w:
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        _publish(staging, pub)          # the mid-traffic swap
+        t1 = time.time()
+        while time.time() - t1 < 2.0:
+            depth_max = max(depth_max, svc.queue.depth())
+            time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    watcher = bA._model_watch
+    reg = obs.registry()
+
+    def metric(name):
+        m = reg.get(name)
+        return getattr(m, "value", 0.0) or 0.0
+
+    assert not drops, f"{len(drops)} request(s) dropped under load"
+    assert watcher.swaps >= 1, "mid-traffic publish never swapped in"
+    assert metric("serve.evictions") >= 1, "1-model LRU never evicted"
+    assert metric("serve.cache_hits") >= 1, "no warm cache hits"
+    w.assert_compiles(0)                # zero warm-path programs
+    assert reg.get("heartbeat.serve") is not None, \
+        "dispatch loop never stamped heartbeat.serve"
+    # post-swap serving must match the published model EXACTLY — a
+    # swap that leaves a stale stack (or truncates adoption) serves
+    # wrong values with the right shape, which only this catches
+    swapped = svc.predict("a", Xq, timeout=10.0)
+    expected = v2.predict(Xq)
+    assert np.array_equal(swapped, expected), \
+        "post-swap serving diverged from the published model"
+    assert not np.array_equal(expected, pre_swap), \
+        "v2 indistinguishable from v1 — the swap assert has no teeth"
+    svc.close()
+    print(json.dumps({
+        "serve_smoke": 1, "secs": round(time.time() - t0, 1),
+        "requests": len(lat), "dropped": 0,
+        "swaps": watcher.swaps,
+        "evictions": metric("serve.evictions"),
+        "cache_hits": metric("serve.cache_hits"),
+        "queue_depth_max": depth_max,
+        "warm_compiles": w.compiles,
+        "post_swap_rows": int(np.shape(swapped)[0]),
+    }), flush=True)
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--models", type=int, default=4)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--leaves", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=64,
+                    help="rows per client request")
+    ap.add_argument("--budget-ms", type=float, default=5.0)
+    ap.add_argument("--max-batch-rows", type=int, default=4096)
+    ap.add_argument("--cache-models", type=int, default=8)
+    ap.add_argument("--shard-trees", type=str, default="auto")
+    ap.add_argument("--p99-target-ms", type=float, default=250.0)
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve live GET /metrics//readyz on "
+                         "127.0.0.1:PORT for the duration of the run")
+    ap.add_argument("--metrics-json", type=str, default="",
+                    help="append one obs metrics-snapshot JSONL line")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CI gate (see run_smoke)")
+    args = ap.parse_args()
+    if args.smoke:
+        return run_smoke()
+    return run_load(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

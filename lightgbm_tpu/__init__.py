@@ -23,7 +23,7 @@ __all__ = [
     "early_stopping", "log_evaluation", "record_evaluation",
     "record_metrics", "reset_parameter", "EarlyStopException",
     "checkpoint", "CheckpointManager", "CheckpointError", "obs",
-    "ModelWatcher",
+    "ModelWatcher", "PredictService", "ModelRegistry",
 ]
 
 
@@ -52,6 +52,9 @@ def __getattr__(name):
         if name == "ModelWatcher":
             from . import serving as _sv
             return _sv.ModelWatcher
+        if name in ("PredictService", "ModelRegistry"):
+            from . import serve as _srv
+            return getattr(_srv, name)
     except ImportError as e:
         raise AttributeError(
             f"module 'lightgbm_tpu' has no attribute {name!r}: {e}") from e

@@ -208,11 +208,25 @@ class Booster:
                 num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **_kwargs) -> np.ndarray:
-        if getattr(self, "_model_watch", None) is not None:
-            # serve-side hot-swap: rate-limited poll of the watched
-            # checkpoint dir; runs on THIS thread before the model is
-            # read, so the request sees old or new atomically
-            self._model_watch.maybe_swap(self)
+        watch = getattr(self, "_model_watch", None)
+        if watch is None:
+            return self._predict_dispatch(
+                data, start_iteration, num_iteration, raw_score,
+                pred_leaf, pred_contrib, _kwargs)
+        # serve-side hot-swap: the rate-limited poll AND the model read
+        # both run under the watcher's swap lock, so any thread's
+        # request sees the old or the new model atomically — the
+        # THREADING CONTRACT serving.py documents, enforced here
+        # instead of delegated to the caller
+        with watch.swap_lock:
+            watch.maybe_swap(self)
+            return self._predict_dispatch(
+                data, start_iteration, num_iteration, raw_score,
+                pred_leaf, pred_contrib, _kwargs)
+
+    def _predict_dispatch(self, data, start_iteration, num_iteration,
+                          raw_score, pred_leaf, pred_contrib,
+                          _kwargs) -> np.ndarray:
         if num_iteration is None:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)

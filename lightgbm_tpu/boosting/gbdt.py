@@ -68,12 +68,32 @@ def _next_pow2(x: int) -> int:
     return 1 << max(x - 1, 0).bit_length()
 
 
+def predict_pad_rows(n_rows: int, chunk_rows, buckets) -> int:
+    """Total rows the predict chunk plan allocates for an ``n_rows``
+    request — THE serving pad policy (pow2 bucket under the chunk
+    floor, whole same-shape chunks above it), shared between
+    ``_run_forest_chunks``'s plan and serve/service.py's
+    ``serve.batch_fill_ratio`` denominator so the gauge can never
+    drift from the dispatched shape."""
+    from ..config import coerce_bool
+    chunk = max(int(chunk_rows), 1024)
+    n = max(int(n_rows), 1)
+    if n > chunk:
+        return -(-n // chunk) * chunk
+    return _predict_row_bucket(n, chunk) if coerce_bool(buckets) else n
+
+
+# smallest pow2 row bucket a predict pads to; serve/service.py's
+# warmup walk starts here so it visits exactly the engine's bucket set
+PREDICT_ROW_BUCKET_FLOOR = 128
+
+
 def _predict_row_bucket(n: int, cap: int) -> int:
     """Pad a predict batch up to the nearest power-of-two row bucket
     (floor 128), capped at the chunk size — arbitrary request sizes then
     hit a BOUNDED traversal compile cache (<= log2(cap/128) programs)
     instead of one program per distinct n."""
-    b = max(_next_pow2(max(n, 1)), 128)
+    b = max(_next_pow2(max(n, 1)), PREDICT_ROW_BUCKET_FLOOR)
     return b if b <= cap else cap
 
 # stacked-forest cache entries kept per engine (distinct (start, num,
@@ -401,6 +421,14 @@ class GBDT:
         # leaf values (_stack_model_list)
         self._models_version = 0
         self._stack_cache: Optional[Tuple[Tuple[int, int], Dict]] = None
+        # tree-sharded predict (serve/shard.py enable_tree_sharding):
+        # when set, stacked forests are placed with the [T] axis
+        # NamedSharding-split over this mesh and predicts take the
+        # sharded traversal; _shard_consts caches the replicated
+        # feat_num_bin/feat_has_nan copies so warm predicts re-place
+        # nothing
+        self._predict_mesh = None
+        self._shard_consts: Optional[Tuple] = None
 
         n_shards = self.mesh.devices.size if self.mesh is not None else 1
         n_rows_layout = self.train_set.num_data
@@ -2511,6 +2539,14 @@ class GBDT:
         class_idx = jnp.asarray(np.asarray(
             list(indices) + [0] * (n_pad - n_real),
             dtype=np.int32) % self.num_class)
+        if getattr(self, "_predict_mesh", None) is not None:
+            # tree-sharded serving: commit the stack with its [T] axis
+            # split over the mesh BEFORE caching, so every warm predict
+            # reuses the sharded placement (re-placing per call would
+            # re-upload the forest per request)
+            from ..serve.shard import place_tree_sharded
+            stacked, class_idx = place_tree_sharded(
+                stacked, class_idx, self._predict_mesh)
         if key is not None:
             cache = self._stack_cache
             if cache is None or cache[0] != ver:
@@ -2683,9 +2719,16 @@ class GBDT:
                 and start_tree == 0 and n_trees == len(self.models)):
             return self._stack_model_list(list(range(n_trees)),
                                           use_cache=use_cache)
+        pad_count = _next_pow2(n_trees)
+        mesh = getattr(self, "_predict_mesh", None)
+        if mesh is not None:
+            # NamedSharding needs the tree axis divisible by the mesh:
+            # pad further with inert single-leaf trees (a pow2 count
+            # already divides pow2 meshes; this covers the rest)
+            pad_count = _ceil_to(pad_count, int(mesh.devices.size))
         return self._stack_model_list(
             list(range(start_tree, start_tree + n_trees)),
-            pad_count=_next_pow2(n_trees),
+            pad_count=pad_count,
             pad_leaves=self.config.num_leaves, use_cache=use_cache)
 
     def _run_forest_chunks(self, stacked, class_idx, bins, n_trees: int,
@@ -2720,11 +2763,15 @@ class GBDT:
         n_rows = bins.shape[0]
         mode = (None if knob("tpu_predict_parallel_trees", coerce_bool)
                 else "scan")
+        mesh = getattr(self, "_predict_mesh", None)
+        consts = getattr(self, "_shard_consts", None)
+        feat_num_bin, feat_has_nan = (
+            consts if (mesh is not None and consts is not None)
+            else (self.feat_num_bin, self.feat_has_nan))
         chunk = max(knob("tpu_predict_chunk_rows", int), 1024)
         if n_rows <= chunk:
-            pad_to = (_predict_row_bucket(n_rows, chunk)
-                      if knob("tpu_predict_buckets", coerce_bool)
-                      else n_rows)
+            pad_to = predict_pad_rows(
+                n_rows, chunk, knob("tpu_predict_buckets", coerce_bool))
             plan = [(0, n_rows, pad_to)]
         else:
             plan = [(s, min(chunk, n_rows - s), chunk)
@@ -2757,9 +2804,16 @@ class GBDT:
                 blk = np.concatenate(
                     [blk, np.zeros((pad_to - rows, blk.shape[1]),
                                    blk.dtype)])
+            if mesh is not None:
+                # replicate THIS request's rows across the mesh (the
+                # H2D upload it would pay anyway, fanned out)
+                from ..serve.shard import replicate_on
+                blk_dev = replicate_on(mesh, blk)
+            else:
+                blk_dev = jnp.asarray(blk)
             raw_dev, leaves_dev = forest_predict_binned(
-                stacked, jnp.asarray(blk), self.feat_num_bin,
-                self.feat_has_nan, class_idx, self.num_class, mode=mode)
+                stacked, blk_dev, feat_num_bin, feat_has_nan,
+                class_idx, self.num_class, mode=mode, mesh=mesh)
             if want_leaves:
                 # leaf-only request: the raw scores are never read back
                 leaves_dev.copy_to_host_async()

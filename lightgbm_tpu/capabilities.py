@@ -50,6 +50,7 @@ __all__ = [
     "STREAM_MAX_LEAVES", "STREAM_TREE_LEARNERS",
     "HIST_PARTITION_MIN_ROWS", "hist_partition_auto",
     "DEVICE_INGEST", "device_ingest_verdict", "forced_engine",
+    "SHARDED_PREDICT", "sharded_predict_verdict",
 ]
 
 SUPPORTED = "supported"
@@ -347,3 +348,30 @@ def device_ingest_verdict(params: Dict[str, Any]) -> str:
     output?  DEMOTE means: bin host-side (warn if the user forced
     ``tpu_ingest_device=true``)."""
     return DEVICE_INGEST.get(forced_engine(params), SUPPORTED)
+
+
+# which engines' PREDICT surface can shard the stacked tree axis over
+# the local mesh (tpu_serve_shard_trees; serve/shard.py +
+# ops/predict.py forest_predict_sharded): DART rescales per-tree leaf
+# values in place every iteration (shrink), so its stacks churn
+# versions and drop subsets are non-contiguous — demote to the
+# unsharded path; the streaming engine predicts through the host model
+# and has no stacked device surface at all. Demotion means: serve
+# unsharded (single-device stacks), never refuse the predict.
+SHARDED_PREDICT: Dict[str, str] = {
+    "gbdt": SUPPORTED,
+    "rf": SUPPORTED,
+    "dart": DEMOTE,
+    "streaming": DEMOTE,
+}
+
+
+def sharded_predict_verdict(engine: str, config=None) -> str:
+    """Verdict for sharding one engine's stacked predict over the tree
+    axis. ``linear_tree`` configs demote on EVERY engine — linear-leaf
+    predicts ride the host-model path (raw feature values), which the
+    device traversal never sees."""
+    if config is not None and bool(getattr(config, "linear_tree",
+                                           False)):
+        return DEMOTE
+    return SHARDED_PREDICT.get(engine, DEMOTE)

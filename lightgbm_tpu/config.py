@@ -423,6 +423,35 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # host re-stacking and HBM re-upload entirely (invalidated on any
     # model mutation)
     "tpu_predict_cache": _P("bool", True),
+    # ---- serving service (lightgbm_tpu/serve/; docs/serving.md) ------
+    # adaptive micro-batching latency budget: the dispatch loop
+    # coalesces concurrent submit() requests for one model until the
+    # OLDEST request has waited this many milliseconds (or the batch
+    # row cap below fills), then dispatches them as one bucketed
+    # predict. 0 = dispatch immediately (no coalescing window)
+    "tpu_serve_batch_budget_ms": _P("float", 5.0, [], (0.0, None)),
+    # row cap per coalesced dispatch: a batch flushes early the moment
+    # its accumulated rows reach this cap (requests larger than the cap
+    # still dispatch alone — the engine chunks them internally)
+    "tpu_serve_max_batch_rows": _P("int", 8192, [], (128, None)),
+    # multi-model LRU (serve/registry.py): how many tenants' stacked
+    # forests may be device-resident at once; the least-recently-used
+    # model's device stack is released past the cap (the Booster stays
+    # registered — the next request re-stacks, compiling nothing)
+    "tpu_serve_cache_models": _P("int", 8, [], (1, None)),
+    # byte cap for the same LRU, against the shared utils/hbm.py
+    # stacked-forest estimate. 0 = auto: SERVE_HBM_FRACTION of the
+    # device HBM limit where the runtime reports one, uncapped
+    # otherwise
+    "tpu_serve_cache_bytes": _P("int", 0, [], (0, None)),
+    # tree-sharded predict (serve/shard.py): shard the stacked [T,...]
+    # forest axis over the local mesh with NamedSharding for forests
+    # too large for one device's HBM. "auto" engages when one model's
+    # stacked estimate exceeds SERVE_HBM_FRACTION of a device; "true"
+    # forces it whenever >= 2 local devices exist; "false" never.
+    # Host-model (linear_tree, streaming) and DART predicts demote to
+    # the unsharded path per capabilities.SHARDED_PREDICT
+    "tpu_serve_shard_trees": _P("str", "auto"),
     # ---- device-accelerated ingest (ops/ingest.py; docs/perf.md
     # "Ingest") -------------------------------------------------------
     # bin ASSIGNMENT of the full raw matrix on the accelerator (bin
@@ -693,6 +722,8 @@ class Config:
                                                  "tpu_ingest_device")
         self.tpu_hist_partition = coerce_tristate(self.tpu_hist_partition,
                                                   "tpu_hist_partition")
+        self.tpu_serve_shard_trees = coerce_tristate(
+            self.tpu_serve_shard_trees, "tpu_serve_shard_trees")
         setup_compile_cache(self.tpu_compile_cache_dir)
         # observability knobs engage process-wide (enable-only: the 2-3
         # Config objects one train() builds must not flip it back off)

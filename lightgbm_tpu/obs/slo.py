@@ -39,13 +39,41 @@ from __future__ import annotations
 import threading
 import time
 from bisect import bisect_left
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .metrics import DEFAULT_BUCKETS, registry
 
 __all__ = ["SlidingHistogram", "SlidingCounter", "SloTracker",
            "tracker", "enable", "enabled", "reset", "feed_hist",
-           "feed_count", "evaluate", "DEFAULT_WINDOW_S", "DEFAULT_SLOTS"]
+           "feed_count", "evaluate", "set_queue_depth_provider",
+           "clear_queue_depth_provider",
+           "DEFAULT_WINDOW_S", "DEFAULT_SLOTS"]
+
+# ``slo.queue_depth`` provider: the serving service (serve/service.py)
+# registers a zero-arg callable returning its live request-queue depth;
+# compute() samples it per evaluation period. None (no service in this
+# process) reads as an empty queue — the gauge stays 0 so dashboards
+# wired before the service starts keep rendering.
+_queue_depth_provider: Optional[Callable[[], float]] = None
+
+
+def set_queue_depth_provider(
+        fn: Optional[Callable[[], float]]) -> None:
+    """Register (or, with None, clear) the live queue-depth source for
+    the ``slo.queue_depth`` gauge."""
+    global _queue_depth_provider
+    _queue_depth_provider = fn
+
+
+def clear_queue_depth_provider(fn: Callable[[], float]) -> bool:
+    """Clear the provider only if it is still ``fn``: a dying service
+    must not zero out the gauge a NEWER service (blue/green restart in
+    one process) has since registered."""
+    global _queue_depth_provider
+    if _queue_depth_provider is fn:
+        _queue_depth_provider = None
+        return True
+    return False
 
 # 5-minute default window in 10 s slots: the Prometheus-default scrape
 # cadence (15 s) sees each slot a few times before it recycles
@@ -294,12 +322,24 @@ class SloTracker:
             "slo.error_ratio": (errors / requests if requests else None),
             "predict.cache_hit_ratio": (hits / (hits + misses)
                                         if (hits + misses) else None),
-            # queue-depth placeholder: the async micro-batching queue
-            # (ROADMAP item 2) will own this; exported now so dashboards
-            # can wire the panel before the queue exists
-            "slo.queue_depth": 0.0,
+            # live queue depth from the serving service's registered
+            # provider (serve/service.py); 0 when no service runs in
+            # this process — never None, so the dashboard panel exists
+            # from the first scrape
+            "slo.queue_depth": self._queue_depth(),
         }
         return out
+
+    @staticmethod
+    def _queue_depth() -> float:
+        fn = _queue_depth_provider
+        if fn is None:
+            return 0.0
+        try:
+            return float(fn())
+        except Exception:
+            # a dying service must not take the scrape path down
+            return 0.0
 
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
         """Refresh the SLO gauges in the process registry and run the
@@ -370,10 +410,12 @@ def enable(window_s: Optional[float] = None,
 
 
 def reset() -> None:
-    """Drop the tracker (window state AND thresholds). Tests only."""
-    global _tracker
+    """Drop the tracker (window state AND thresholds) and any
+    registered queue-depth provider. Tests only."""
+    global _tracker, _queue_depth_provider
     with _lock:
         _tracker = None
+        _queue_depth_provider = None
 
 
 def feed_hist(name: str, value: float,

@@ -30,7 +30,7 @@ accumulation replays the reference scan's sequential tree order.
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -398,12 +398,90 @@ def predict_program_cache_size() -> int:
     return _forest_predict_impl._cache_size()
 
 
+# ---------------------------------------------------------------------------
+# Tree-sharded traversal (serve/shard.py places the operands)
+# ---------------------------------------------------------------------------
+# One jitted traversal per (mesh, formulation): inputs arrive committed
+# — stacked arrays NamedSharding-split on the tree axis, rows/feature
+# tables replicated — and out_shardings forces the per-(tree, row) leaf
+# VALUES back to REPLICATED, so the class accumulation below replays
+# the exact global sequential tree order on gathered values. That is
+# the bit-identity argument: per-tree traversal is pure selection
+# (exact under any batch split), and the f32 score accumulation runs
+# the same scan over the same values in the same order as the
+# single-device path — no cross-shard partial sums whose reassociation
+# could flip low bits. The [T, n] leaf INDICES stay tree-sharded: only
+# the rare pred_leaf request reads them (host fetch gathers then), and
+# replicating them would all-gather T*n int32 per warm dispatch for
+# nothing.
+_SHARDED_TRAVERSE: Dict[Tuple, Any] = {}
+
+
+def _sharded_traverse_fn(mesh, formulation: str):
+    key = (mesh, formulation)
+    fn = _SHARDED_TRAVERSE.get(key)
+    if fn is None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(mesh, PartitionSpec())
+        leaf_shard = NamedSharding(
+            mesh, PartitionSpec(mesh.axis_names[0], None))
+
+        def run(stacked, bins, feat_num_bin, feat_has_nan):
+            # _level_traverse directly (not _forest_traverse): the
+            # budget tree-blocking would reshape the sharded axis; the
+            # per-device operand is already 1/D of the forest
+            return _level_traverse(stacked, bins, feat_num_bin,
+                                   feat_has_nan, formulation)
+
+        fn = jax.jit(run, out_shardings=(repl, leaf_shard))
+        _SHARDED_TRAVERSE[key] = fn
+    return fn
+
+
+@functools.partial(jax.jit, static_argnames=("num_class",))
+def _class_accumulate_jit(vals, class_index, num_class):
+    return _class_accumulate(vals, class_index, num_class)
+
+
+def forest_predict_sharded(stacked: Dict[str, jax.Array],
+                           bins: jax.Array, feat_num_bin: jax.Array,
+                           feat_has_nan: jax.Array,
+                           class_index: jax.Array, num_class: int,
+                           mesh,
+                           formulation: Optional[str] = None
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """Tree-sharded forest predict: same signature and bit-identical
+    outputs as :func:`forest_predict_binned`, for stacked arrays
+    already placed with their ``[T]`` axis NamedSharding-split over
+    ``mesh`` (serve/shard.py ``place_tree_sharded``) and everything
+    else replicated. Always level-synchronous — the per-tree scan mode
+    has no tree axis to shard."""
+    T, Ln = stacked["split_feature"].shape
+    if formulation is None:
+        formulation = default_formulation(Ln)
+    if formulation == "onehot":
+        # no tree-blocking on the sharded path (_forest_traverse's
+        # reshape would cut the sharded axis), so bound the one-hot
+        # operand the other way: past the per-DEVICE budget, fall back
+        # to the memory-lean gather step instead of materializing an
+        # unbounded [T/D, n, Ln] membership tensor
+        n, F = bins.shape
+        per_dev_T = -(-T // max(int(mesh.devices.size), 1))
+        if per_dev_T * n * max(Ln, F, 1) > LEVEL_ONEHOT_BUDGET:
+            formulation = "gather"
+    vals, leaves = _sharded_traverse_fn(mesh, formulation)(
+        stacked, bins, feat_num_bin, feat_has_nan)
+    scores = _class_accumulate_jit(vals, class_index, num_class)
+    return scores, leaves
+
+
 def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
                           feat_num_bin: jax.Array, feat_has_nan: jax.Array,
                           class_index: jax.Array,
                           num_class: int,
                           mode: Optional[str] = None,
-                          formulation: Optional[str] = None
+                          formulation: Optional[str] = None,
+                          mesh=None
                           ) -> Tuple[jax.Array, jax.Array]:
     """Sum leaf outputs of a stacked forest into per-class raw scores.
 
@@ -417,6 +495,10 @@ def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
         and the ``tpu_predict_parallel_trees=false`` escape hatch).
       formulation: level-step kind, "onehot" | "gather"; None picks by
         backend and tree width (``default_formulation``).
+      mesh: when set, the stacked arrays arrive tree-axis sharded over
+        this mesh (serve/shard.py) and the sharded level path runs —
+        it takes precedence over ``mode`` (the per-tree scan has no
+        tree axis to shard).
 
     Returns:
       (raw scores ``[n, num_class]``, leaf indices ``[T, n]``)
@@ -427,21 +509,29 @@ def forest_predict_binned(stacked: Dict[str, jax.Array], bins: jax.Array,
     """
     if mode is None or mode == "auto":
         mode = "level"
+    if mesh is not None:
+        mode = "level"
     if mode == "scan":
         formulation = None
     elif formulation is None:
         formulation = default_formulation(stacked["split_feature"].shape[1])
-    from .. import obs
-    if not obs.any_enabled():
+
+    def dispatch():
+        if mesh is not None:
+            return forest_predict_sharded(
+                stacked, bins, feat_num_bin, feat_has_nan, class_index,
+                num_class, mesh, formulation)
         return _forest_predict_impl(stacked, bins, feat_num_bin,
                                     feat_has_nan, class_index, num_class,
                                     mode, formulation)
+
+    from .. import obs
+    if not obs.any_enabled():
+        return dispatch()
     # serving dispatch span: wall time covers trace/compile + enqueue
     # (execution is async — completion shows up where the caller blocks
     # on the device->host copy)
     with obs.span("predict/forest_dispatch", rows=int(bins.shape[0]),
                   trees=int(stacked["split_feature"].shape[0]),
-                  mode=mode):
-        return _forest_predict_impl(stacked, bins, feat_num_bin,
-                                    feat_has_nan, class_index, num_class,
-                                    mode, formulation)
+                  mode=("sharded" if mesh is not None else mode)):
+        return dispatch()

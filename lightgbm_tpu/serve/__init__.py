@@ -1,0 +1,24 @@
+"""Serving service (docs/serving.md): the process in front of the
+engine's predict surface.
+
+Three layers, one import:
+
+- **Queue** (serve/queue.py): thread-safe request queue with adaptive
+  micro-batching — concurrent ``submit(model_id, X)`` calls coalesce
+  into one bucketed dispatch per model under the
+  ``tpu_serve_batch_budget_ms`` latency cutoff.
+- **Registry** (serve/registry.py): multi-tenant bounded LRU of
+  device-resident stacked forests (``tpu_serve_cache_models`` /
+  ``tpu_serve_cache_bytes``), with per-model hot-swap watchers.
+- **Shard** (serve/shard.py): tree-axis ``NamedSharding`` for forests
+  too large for one device (``tpu_serve_shard_trees``), bit-identical
+  to single-device predict.
+
+Entry point: :class:`~.service.PredictService`.
+"""
+from .registry import ModelRegistry
+from .service import PredictService
+from .shard import enable_tree_sharding, tree_mesh
+
+__all__ = ["PredictService", "ModelRegistry", "enable_tree_sharding",
+           "tree_mesh"]

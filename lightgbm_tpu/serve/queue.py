@@ -1,0 +1,220 @@
+"""Async request queue + adaptive micro-batching primitives.
+
+The coalescing contract (docs/serving.md): concurrent ``submit``
+requests for one model ride ONE bucketed dispatch when they arrive
+within the latency budget. A dispatch takes the model's **maximal FIFO
+prefix** that fits the row cap — strict per-model submit order, a
+later request never overtakes an earlier one that did not fit — and a
+batch flushes the moment either
+
+- that prefix reaches the row cap (``tpu_serve_max_batch_rows`` — the
+  "bucket filled" signal; the engine pads the dispatch up to PR 7's
+  power-of-two row buckets, so fuller batches mean higher
+  ``serve.batch_fill_ratio`` at the same compiled shapes; a request
+  larger than the cap alone is its own full prefix and dispatches
+  alone), or
+- the OLDEST queued request has waited ``tpu_serve_batch_budget_ms``
+  (the latency-budget cutoff — a lone request never waits longer than
+  the budget for company that is not coming), or
+- a request arrives that does not fit the remaining cap: the prefix is
+  frozen (strict FIFO — no later request may join past it), so the
+  batch dispatches immediately rather than burning the budget.
+
+The fill signal and the pop agree by construction: both read the same
+maintained prefix, so rows queued BEHIND a request that does not fit
+can never flush a nearly-empty batch early.
+
+FIFO across models: the dispatcher always serves the model of the
+oldest queued request, so one chatty tenant cannot starve another.
+This module is pure queueing — no JAX, no engine; the dispatch itself
+lives in serve/service.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PredictRequest", "MicroBatchQueue"]
+
+
+class PredictRequest:
+    """One queued predict: rows + the future its caller blocks on."""
+
+    __slots__ = ("model_id", "X", "rows", "future", "t_enqueue",
+                 "deadline", "dispatched")
+
+    def __init__(self, model_id: str, X, budget_s: float):
+        self.model_id = str(model_id)
+        self.X = X
+        self.rows = int(np.shape(X)[0])
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+        self.deadline = self.t_enqueue + max(float(budget_s), 0.0)
+        self.dispatched = False
+
+
+class MicroBatchQueue:
+    """Thread-safe per-model FIFO of :class:`PredictRequest` with
+    prefix-batch pops.
+
+    ``depth()`` is the live ``slo.queue_depth`` feed — requests
+    admitted but not yet handed to a dispatch.
+
+    Internal invariant (everything under ``_cond``'s lock):
+    ``_prefix[m]`` is the row total of model m's maximal poppable FIFO
+    prefix, and ``_open[m]`` says whether that prefix still covers the
+    model's WHOLE deque (so a new submit may extend it O(1)). The
+    dispatch wake-up's fill check reads ``_prefix`` instead of
+    re-scanning the queue.
+    """
+
+    def __init__(self, budget_s: float, max_batch_rows: int):
+        self.budget_s = max(float(budget_s), 0.0)
+        self.max_batch_rows = max(int(max_batch_rows), 1)
+        # global submit order (lazily cleaned of dispatched entries —
+        # pops remove from the per-model deques only)
+        self._order: Deque[PredictRequest] = deque()
+        self._by_model: Dict[str, Deque[PredictRequest]] = {}
+        self._prefix: Dict[str, int] = {}
+        self._open: Dict[str, bool] = {}
+        self._depth = 0
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def submit(self, model_id: str, X) -> Future:
+        """Enqueue one request; returns the Future its rows resolve
+        through. Raises RuntimeError after close() — a shutting-down
+        service must refuse loudly, not drop silently."""
+        req = PredictRequest(model_id, X, self.budget_s)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("serve queue is closed")
+            d = self._by_model.get(req.model_id)
+            if d is None:
+                d = self._by_model[req.model_id] = deque()
+            if not d:
+                # a lone head is always its own prefix, oversize or not
+                self._prefix[req.model_id] = req.rows
+                self._open[req.model_id] = True
+            elif self._open[req.model_id]:
+                fits = (self._prefix[req.model_id] + req.rows
+                        <= self.max_batch_rows)
+                if fits:
+                    self._prefix[req.model_id] += req.rows
+                else:
+                    self._open[req.model_id] = False
+            d.append(req)
+            self._order.append(req)
+            self._depth += 1
+            self._cond.notify_all()
+        return req.future
+
+    def depth(self) -> int:
+        """Requests admitted and not yet dispatched (lock-free read of
+        a maintained int — scrape threads call this)."""
+        return self._depth
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> List[PredictRequest]:
+        """Refuse new submits and hand back whatever is still queued so
+        the service can fail those futures explicitly (zero SILENT
+        drops even at shutdown)."""
+        with self._cond:
+            self._closed = True
+            leftover = [r for r in self._order if not r.dispatched]
+            self._order.clear()
+            self._by_model.clear()
+            self._prefix.clear()
+            self._open.clear()
+            self._depth = 0
+            self._cond.notify_all()
+        return leftover
+
+    # ------------------------------------------------------------------
+    def _head(self) -> Optional[PredictRequest]:
+        """Oldest undispatched request. Caller holds the lock."""
+        q = self._order
+        while q and q[0].dispatched:
+            q.popleft()
+        return q[0] if q else None
+
+    def _rescan_prefix(self, model_id: str,
+                       d: "Deque[PredictRequest]") -> None:
+        """Rebuild ``_prefix``/``_open`` for a model's remaining deque
+        after a pop — O(next batch), it stops at the cap. Caller holds
+        the lock."""
+        acc = 0
+        opened = True
+        for r in d:
+            if acc >= self.max_batch_rows or (
+                    acc and acc + r.rows > self.max_batch_rows):
+                opened = False
+                break
+            acc += r.rows
+        self._prefix[model_id] = acc
+        self._open[model_id] = opened
+
+    def next_batch(self, poll_s: float = 0.05
+                   ) -> Optional[Tuple[str, List[PredictRequest]]]:
+        """Block up to ~``poll_s`` for work, then pop the oldest
+        request's model's maximal FIFO prefix per the flush rules
+        above. Returns None on an empty poll or after close() — the
+        dispatch loop's idle tick.
+        """
+        with self._cond:
+            head = self._head()
+            if head is None:
+                if self._closed:
+                    return None
+                self._cond.wait(poll_s)
+                head = self._head()
+                if head is None:
+                    return None
+            model_id = head.model_id
+            # coalescing window: sleep toward the oldest deadline,
+            # waking on every submit to re-check the fill level
+            while not self._closed:
+                if self._prefix.get(model_id, 0) >= self.max_batch_rows:
+                    break
+                if not self._open.get(model_id, True):
+                    # a non-fitting request FROZE the prefix — under
+                    # strict FIFO nothing can ever join this batch, so
+                    # waiting out the budget would be pure added
+                    # latency for it AND the request blocked behind it
+                    break
+                now = time.monotonic()
+                if now >= head.deadline:
+                    break
+                self._cond.wait(head.deadline - now)
+            d = self._by_model.get(model_id)
+            if not d:
+                return None         # close() drained it mid-wait
+            batch: List[PredictRequest] = []
+            rows = 0
+            while d:
+                r = d[0]
+                if batch and rows + r.rows > self.max_batch_rows:
+                    break           # prefix ends HERE: strict FIFO,
+                d.popleft()         # later requests never overtake r
+                r.dispatched = True
+                batch.append(r)
+                rows += r.rows
+                if rows >= self.max_batch_rows:
+                    break
+            self._depth -= len(batch)
+            if d:
+                self._rescan_prefix(model_id, d)
+            else:
+                del self._by_model[model_id]
+                self._prefix.pop(model_id, None)
+                self._open.pop(model_id, None)
+            return (model_id, batch)

@@ -1,0 +1,275 @@
+"""Multi-tenant model registry: a bounded LRU of device forests.
+
+One serving process holds MANY tenants' boosters; what must be bounded
+is not the host-side tree lists (cheap) but the device-resident
+stacked forests each model's warm predicts pin in HBM. The registry
+keeps every registered Booster forever and runs an LRU over which of
+them may be DEVICE-RESIDENT:
+
+- capacity is ``tpu_serve_cache_models`` models AND
+  ``tpu_serve_cache_bytes`` bytes (0 = auto against the shared
+  utils/hbm.py estimate and HBM limit probe);
+- residency identity is the engine's existing
+  ``(len(models), _models_version)`` stack key — a hot-swap
+  (serving.ModelWatcher) bumps the version, and the registry re-costs
+  the entry on its next checkout instead of trusting a stale estimate;
+- eviction releases the engine's stacked-forest device cache
+  (``_stack_cache``); the Booster stays registered, and the next
+  checkout re-admits it — a re-stack, NOT a recompile (stable bucketed
+  shapes), and never a dropped request.
+
+Metrics (docs/observability.md): ``serve.cache_hits`` /
+``serve.evictions`` counters, ``serve.cache_models`` /
+``serve.cache_bytes`` gauges.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional
+
+from .. import obs
+from ..config import Config
+from ..utils import log
+from ..utils.hbm import SERVE_HBM_FRACTION, hbm_bytes_limit
+from .shard import auto_shard_mesh, forest_bytes_estimate
+
+__all__ = ["ModelRegistry"]
+
+
+class _Entry:
+    __slots__ = ("model_id", "booster", "resident", "bytes", "key",
+                 "lock")
+
+    def __init__(self, model_id: str, booster):
+        self.model_id = model_id
+        self.booster = booster
+        self.resident = False
+        self.bytes = 0
+        self.key: Optional[tuple] = None
+        # serializes ENGINE mutation (release, shard policy) against
+        # the dispatch thread's in-flight predict on this booster: the
+        # service holds it from admission through each dispatched
+        # predict (begin_dispatch), and register/evict from user
+        # threads take it before touching the engine. Always acquired
+        # AFTER the registry lock, never the other way (one fixed
+        # order, no deadlock).
+        self.lock = threading.RLock()
+
+
+class ModelRegistry:
+    """Bounded LRU of device-resident stacked forests (module doc)."""
+
+    def __init__(self, params=None, max_models: Optional[int] = None,
+                 max_bytes: Optional[int] = None):
+        cfg = params if isinstance(params, Config) \
+            else Config(dict(params or {}))
+        self.config = cfg
+        self.max_models = int(max_models if max_models is not None
+                              else cfg.tpu_serve_cache_models)
+        if max_bytes is None:
+            max_bytes = int(cfg.tpu_serve_cache_bytes)
+        if max_bytes == 0:
+            limit = hbm_bytes_limit()
+            max_bytes = (int(limit * SERVE_HBM_FRACTION) if limit
+                         else 0)          # 0 = no byte cap (count only)
+        self.max_bytes = int(max_bytes)
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    def register(self, model_id: str, booster,
+                 watch_dir: Optional[str] = None,
+                 watch_interval: float = 2.0) -> None:
+        """Add (or replace) one tenant's Booster. ``watch_dir`` wires
+        the per-model hot-swap watcher (serving.ModelWatcher); the
+        tree-shard policy (``tpu_serve_shard_trees``) is applied here
+        so every model the registry serves routed through one gate."""
+        model_id = str(model_id)
+        entry = _Entry(model_id, booster)
+        with self._lock:
+            old = self._entries.pop(model_id, None)
+            # a re-register can hand back the very booster a dispatch
+            # is mid-predict on: the old entry's lock serializes the
+            # engine mutations below against that predict (a brand-new
+            # booster object has no dispatches yet — its own fresh
+            # lock is uncontended)
+            guard = old.lock if old is not None else entry.lock
+            with guard:
+                if old is not None and old.resident:
+                    # a deploy refresh, not cache pressure: free the
+                    # old device forest without counting an eviction
+                    self._release(old, count=False)
+                if watch_dir:
+                    booster.watch_checkpoints(watch_dir,
+                                              interval=watch_interval)
+                elif getattr(booster, "_engine", None) is not None:
+                    # pin bucketed predict shapes even without a
+                    # watcher: LRU re-admission must reuse the same
+                    # compiled programs
+                    booster._engine._stable_predict_shapes = True
+                auto_shard_mesh(booster, self.config)
+            if old is not None:
+                # dispatches still in flight for this model keep
+                # serializing on the lock they already fetched
+                entry.lock = old.lock
+            # popped + re-inserted: the refreshed model lands at the
+            # most-recent end, never the next LRU victim
+            self._entries[model_id] = entry
+
+    def model_ids(self):
+        with self._lock:
+            return list(self._entries)
+
+    def resident_ids(self):
+        with self._lock:
+            return [e.model_id for e in self._entries.values()
+                    if e.resident]
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.bytes for e in self._entries.values()
+                       if e.resident)
+
+    # ------------------------------------------------------------------
+    def checkout(self, model_id: str):
+        """LRU-touch and return the Booster for one dispatch, admitting
+        its device forest (evicting colder tenants as needed). Raises
+        KeyError for an unregistered model — the service fails those
+        futures explicitly.
+
+        A predict on the returned Booster is NOT serialized against
+        concurrent register/evict engine mutations — that protection
+        belongs to the serving dispatch loop's :meth:`begin_dispatch`,
+        which keeps the per-model lock held from admission through the
+        predict. Use checkout for single-threaded callers and tests."""
+        with self._lock:
+            return self._admit(model_id).booster
+
+    def begin_dispatch(self, model_id: str):
+        """Checkout for the serving dispatch loop: admit + LRU-touch,
+        then return ``(booster, lock)`` with the per-model lock
+        ALREADY HELD — the caller releases it after its predict. The
+        lock is continuous from admission through the predict, so an
+        evict() between the two cannot release a stack the predict is
+        about to repopulate (which would leave real HBM residency
+        accounted as zero)."""
+        with self._lock:
+            entry = self._admit(model_id)
+            entry.lock.acquire()    # registry -> entry order, held out
+            return entry.booster, entry.lock
+
+    def _admit(self, model_id: str) -> "_Entry":
+        """LRU-touch + device-forest admission. Caller holds the
+        registry lock."""
+        entry = self._entries.get(str(model_id))
+        if entry is None:
+            raise KeyError(f"model {model_id!r} is not registered")
+        self._entries.move_to_end(entry.model_id)
+        key = self._stack_key(entry.booster)
+        if entry.resident and key == entry.key:
+            obs.inc("serve.cache_hits")
+        else:
+            # admission (first touch, post-eviction re-admission, or a
+            # hot-swap that bumped the stack identity): re-run the
+            # shard policy — a swap may have grown the forest past the
+            # single-device auto threshold — then re-cost and make
+            # room. Engine mutation under the entry lock: another
+            # service sharing this registry may be mid-predict on the
+            # same booster.
+            with entry.lock:
+                auto_shard_mesh(entry.booster, self.config)
+                entry.bytes = self._estimate(entry.booster)
+                # key AFTER the policy: first-time shard enablement
+                # bumps the model version, and storing the pre-policy
+                # key would re-take this admission path every checkout
+                entry.key = self._stack_key(entry.booster)
+            entry.resident = True
+            self._enforce_caps(keep=entry.model_id)
+        self._refresh_gauges()
+        return entry
+
+    def evict(self, model_id: str) -> bool:
+        """Explicitly release one model's device forest (it stays
+        registered). Returns True when it was resident."""
+        with self._lock:
+            entry = self._entries.get(str(model_id))
+            if entry is None or not entry.resident:
+                return False
+            with entry.lock:    # vs a dispatch mid-predict (who would
+                self._release(entry)     # repopulate the stack cache)
+            self._refresh_gauges()
+            return True
+
+    # ------------------------------------------------------------------
+    def _stack_key(self, booster) -> Optional[tuple]:
+        """The engine's stacked-forest identity. Caller holds the lock."""
+        eng = getattr(booster, "_engine", None)
+        if eng is None:
+            return None
+        return (len(eng.models),
+                getattr(eng, "_models_version", 0))
+
+    def _estimate(self, booster) -> int:
+        """Device-byte cost of one resident model. Caller holds the
+        lock. Host-model boosters (no engine) pin no device stack."""
+        eng = getattr(booster, "_engine", None)
+        if eng is None:
+            return 0
+        est = forest_bytes_estimate(eng)
+        mesh = getattr(eng, "_predict_mesh", None)
+        if mesh is not None:
+            # tree-sharded stacks spread over the mesh: per-device
+            # residency is what the cap protects
+            est = -(-est // max(int(mesh.devices.size), 1))
+        return est
+
+    def _enforce_caps(self, keep: str) -> None:
+        """Evict LRU residents until count and byte caps hold (never
+        the entry being admitted). Caller holds the lock."""
+        while True:
+            resident = [e for e in self._entries.values() if e.resident]
+            over_count = len(resident) > self.max_models
+            over_bytes = (self.max_bytes > 0
+                          and sum(e.bytes for e in resident)
+                          > self.max_bytes)
+            if not (over_count or over_bytes):
+                return
+            victim = next((e for e in self._entries.values()
+                           if e.resident and e.model_id != keep), None)
+            if victim is None:
+                # one model alone over the byte cap: serve it anyway —
+                # the cap bounds the FLEET, it must not brick a tenant
+                if over_bytes:
+                    log.warning(
+                        f"serve registry: model {keep!r} alone exceeds "
+                        f"the device-cache byte cap "
+                        f"({self.max_bytes}); serving it uncapped")
+                return
+            # the lock serializes vs begin_dispatch predicts (a
+            # checkout()-path predict is unserialized by contract —
+            # see checkout's docstring)
+            with victim.lock:
+                self._release(victim)
+
+    def _release(self, entry: "_Entry", count: bool = True) -> None:
+        """Drop one entry's device forest. Caller holds the lock."""
+        eng = getattr(entry.booster, "_engine", None)
+        if eng is not None:
+            # the stacked-forest device cache IS the HBM residency;
+            # dropping it releases the device buffers once in-flight
+            # dispatches finish (tests pin the live-buffer count)
+            eng._stack_cache = None
+        entry.resident = False
+        entry.bytes = 0
+        entry.key = None
+        if count:
+            obs.inc("serve.evictions")
+
+    def _refresh_gauges(self) -> None:
+        """Residency gauges after any admission/eviction. Caller holds
+        the lock."""
+        resident = [e for e in self._entries.values() if e.resident]
+        obs.set_gauge("serve.cache_models", float(len(resident)))
+        obs.set_gauge("serve.cache_bytes",
+                      float(sum(e.bytes for e in resident)))

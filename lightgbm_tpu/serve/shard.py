@@ -1,0 +1,170 @@
+"""Tree-sharded predict: placement + policy for forests > one device.
+
+A stacked forest is ``[T, ...]`` device arrays (gbdt.py
+``_stack_model_list``); at a few thousand deep trees those tables are
+the HBM item that stops fitting long before the request rows do. This
+module splits the TREE axis over the local mesh with ``NamedSharding``
+(the pjit/NamedSharding idiom of SNIPPETS.md [1][2]) so each device
+holds 1/D of the forest and traverses its block against replicated
+rows; ``ops/predict.py::forest_predict_sharded`` gathers the per-tree
+leaf values back replicated and replays the exact global sequential
+class accumulation — outputs are BIT-IDENTICAL to the single-device
+path (tests/test_shard_predict.py pins this on the fake-device mesh).
+
+Policy rides the capability table (``capabilities.SHARDED_PREDICT``):
+DART's in-place leaf rescales and the host-model predict paths
+(streaming engine, ``linear_tree``) DEMOTE to the unsharded path —
+they serve fine, just unsplit. ``tpu_serve_shard_trees`` is the knob:
+``auto`` engages when one model's stacked estimate
+(utils/hbm.py ``stacked_forest_bytes``) exceeds
+``SERVE_HBM_FRACTION`` of a device, ``true`` forces it on any >= 2
+device host, ``false`` never.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import capabilities
+from ..utils import log
+from ..utils.hbm import (SERVE_HBM_FRACTION, hbm_bytes_limit,
+                         stacked_forest_bytes)
+
+__all__ = ["TREE_AXIS", "tree_mesh", "place_tree_sharded",
+           "replicate_on", "engine_kind", "forest_bytes_estimate",
+           "enable_tree_sharding", "auto_shard_mesh"]
+
+TREE_AXIS = "trees"
+
+
+def tree_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the tree axis (trees sharded, rows replicated)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (TREE_AXIS,))
+
+
+def replicate_on(mesh: Mesh, arr):
+    """Commit ``arr`` replicated on every mesh device."""
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+def place_tree_sharded(stacked: Dict, class_idx, mesh: Mesh
+                       ) -> Tuple[Dict, object]:
+    """Commit a stacked forest with its leading ``[T]`` axis split over
+    ``mesh`` (every per-tree table shards; the class index stays
+    replicated — the accumulation scan consumes it on gathered
+    values). A tree count the mesh does not divide places replicated
+    instead — the caller's pad path (``_stack_for_predict``) prevents
+    that in serving, but training-side stacks (score rebuilds) must
+    never crash here."""
+    T = int(stacked["split_feature"].shape[0])
+    D = int(mesh.devices.size)
+    if D <= 1 or T % D != 0:
+        repl = NamedSharding(mesh, P())
+        return ({k: jax.device_put(v, repl) for k, v in stacked.items()},
+                jax.device_put(class_idx, repl))
+    placed = {
+        k: jax.device_put(
+            v, NamedSharding(mesh, P(TREE_AXIS,
+                                     *([None] * (v.ndim - 1)))))
+        for k, v in stacked.items()}
+    return placed, replicate_on(mesh, class_idx)
+
+
+def engine_kind(engine) -> str:
+    """Capability-table engine key for a live engine object."""
+    name = type(engine).__name__
+    return {"GBDT": "gbdt", "DART": "dart", "RandomForest": "rf",
+            "StreamingGBDT": "streaming"}.get(name, name.lower())
+
+
+def forest_bytes_estimate(engine) -> int:
+    """The shared utils/hbm.py stacked-forest estimate for this
+    engine's CURRENT model, at the stable serving pad shapes — the
+    pow2 (and, sharded, mesh-divisible) tree-count padding
+    `_stack_for_predict` actually allocates (a 520-tree model stacks
+    1024 padded slots; costing the raw count would let the registry
+    byte cap admit ~2x the real bytes)."""
+    from ..boosting.gbdt import _ceil_to, _next_pow2
+    n_trees = len(getattr(engine, "models", []) or [])
+    if getattr(engine, "_stable_predict_shapes", False) and n_trees:
+        n_trees = _next_pow2(n_trees)
+    est_mesh = getattr(engine, "_predict_mesh", None)
+    if est_mesh is not None and n_trees:
+        # the sharded stack pads further to a mesh-divisible count
+        # (gbdt._stack_for_predict); cost what is actually pinned
+        n_trees = _ceil_to(n_trees, int(est_mesh.devices.size))
+    leaves = int(getattr(engine.config, "num_leaves", 31))
+    words = 0
+    if getattr(engine, "has_categorical", False):
+        words = (int(getattr(engine, "B", 32)) + 31) // 32
+    return stacked_forest_bytes(n_trees, leaves, words)
+
+
+def enable_tree_sharding(booster, mesh: Optional[Mesh] = None
+                         ) -> Optional[Mesh]:
+    """Pin a serving Booster's predicts to the tree-sharded path.
+
+    Returns the mesh in effect, or None when the capability table
+    demotes this booster (host-model path, DART) or the host has one
+    device — in which case nothing changes and the unsharded path
+    keeps serving. Invalidates the stacked-forest cache so the next
+    predict re-stacks at mesh-divisible padded shapes.
+    """
+    eng = getattr(booster, "_engine", None)
+    if eng is None or getattr(booster, "_from_model", None) is not None:
+        log.info("tree-sharded predict demoted: model-file boosters "
+                 "serve through the host model")
+        return None
+    verdict = capabilities.sharded_predict_verdict(
+        engine_kind(eng), getattr(eng, "config", None))
+    if verdict != capabilities.SUPPORTED:
+        log.info(f"tree-sharded predict demoted for the "
+                 f"{type(eng).__name__} engine "
+                 f"(capabilities.SHARDED_PREDICT); serving unsharded")
+        return None
+    if mesh is None:
+        if len(jax.devices()) < 2:
+            return None
+        mesh = tree_mesh()
+    if int(mesh.devices.size) < 2:
+        return None
+    if getattr(eng, "_predict_mesh", None) == mesh:
+        # already engaged on this mesh: a re-applied policy (every LRU
+        # admission runs it) must not bump the model version / drop the
+        # stack cache, or warm checkouts re-stack forever
+        return mesh
+    eng._predict_mesh = mesh
+    # stable bucketed shapes so every model in a size bucket — and the
+    # mesh-divisible pad — reuses the compiled sharded programs
+    eng._stable_predict_shapes = True
+    eng._shard_consts = (replicate_on(mesh, eng.feat_num_bin),
+                         replicate_on(mesh, eng.feat_has_nan))
+    eng._invalidate_forest_cache()
+    return mesh
+
+
+def auto_shard_mesh(booster, cfg) -> Optional[Mesh]:
+    """Apply the ``tpu_serve_shard_trees`` policy to one serving
+    booster; returns the mesh engaged (or None)."""
+    knob = str(getattr(cfg, "tpu_serve_shard_trees", "auto"))
+    if knob == "false":
+        return None
+    if knob == "true":
+        return enable_tree_sharding(booster)
+    # auto: shard only when one resident copy of this forest would
+    # crowd a single device
+    eng = getattr(booster, "_engine", None)
+    if eng is None:
+        return None
+    limit = hbm_bytes_limit()
+    if not limit:
+        return None
+    if forest_bytes_estimate(eng) <= SERVE_HBM_FRACTION * limit:
+        return None
+    return enable_tree_sharding(booster)

@@ -15,11 +15,20 @@ Design:
   (``tpu_model_watch_interval``, default 2 s). Swap and predict run on
   the same thread, so a request observes either the old or the new
   model atomically — ZERO dropped requests by construction. THREADING
-  CONTRACT: that atomicity is per-thread; warm adoption mutates the
-  live engine (models list, caches), so a MULTI-THREADED server must
-  serialize predicts against swaps itself (one serving loop per
-  Booster, or an external read/write lock) — concurrent predicts
-  during a swap may observe a mid-swap engine.
+  CONTRACT: warm adoption mutates the live engine (models list,
+  caches), so predicts must serialize against swaps. The watcher owns
+  that contract as code, not convention: :attr:`ModelWatcher.swap_lock`
+  is a reentrant lock adoption runs under, ``Booster.predict`` wraps
+  its whole model read (poll + traversal) in it, and the serving
+  service's dispatch loop (serve/service.py) acquires the same lock
+  around each coalesced batch — a multi-threaded server gets
+  old-or-new atomicity per request for free
+  (tests/test_serve_queue.py pins concurrent swap-under-load).
+  Predicts on one watched booster therefore SERIALIZE — deliberate:
+  the engine's predict path mutates shared caches and was never safe
+  to run concurrently on one engine; scale throughput with the
+  service's coalescing (one dispatch serves many requests) or more
+  processes, not more threads per booster.
 - **Warm adoption**: when the serving Booster has a resident engine
   and the checkpoint carries pickled trees from a compatible engine
   (GBDT / StreamingGBDT — DART/RF carry mutable per-tree state and
@@ -50,6 +59,7 @@ the metrics pillar off; docs/observability.md catalogue):
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -78,6 +88,10 @@ class ModelWatcher:
         self.interval = max(float(interval), 0.0)
         self.rank = int(rank)
         self._mgr = CheckpointManager(self.dir, rank=self.rank)
+        # the swap/predict serialization point (module docstring
+        # THREADING CONTRACT): reentrant so a predict already holding
+        # it can poll-and-swap on its own thread without deadlock
+        self.swap_lock = threading.RLock()
         # first-adoption baseline: publishes from BEFORE the watch
         # started only adopt when they are not behind the model the
         # booster already holds (see the forward rule in maybe_swap)
@@ -198,7 +212,12 @@ class ModelWatcher:
                            and file_id[0] >= self._loaded_key[1][0]))
         if key != self._loaded_key and forward:
             try:
-                self._adopt(booster, state)
+                # adoption mutates the live engine: hold the swap lock
+                # so a concurrent predict (another thread on this
+                # booster, or the service dispatch loop) sees the old
+                # or the new model, never a mid-swap engine
+                with self.swap_lock:
+                    self._adopt(booster, state)
                 self._loaded_iteration = it
                 self._loaded_key = key
                 self._loaded_mtime = self._ckpt_mtime(state)

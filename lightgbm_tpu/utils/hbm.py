@@ -23,6 +23,15 @@ STREAM_HBM_FRACTION = 0.6
 # device OOM) above this fraction
 ENGINE_HBM_FRACTION = 0.92
 
+# serving-side budgets share the same probe: tree-sharded predict
+# engages (tpu_serve_shard_trees=auto) when ONE model's stacked forest
+# would exceed this fraction of a single device's HBM, and the
+# multi-model LRU's auto byte cap (tpu_serve_cache_bytes=0) bounds the
+# SUM of resident stacks to the same fraction — the two serve gates
+# reason about the same estimate, so a forest the shard gate splits is
+# never one the cache gate would have admitted whole
+SERVE_HBM_FRACTION = 0.5
+
 
 def hbm_bytes_limit() -> Optional[int]:
     """``bytes_limit`` of device 0, or None (CPU / older runtimes that
@@ -50,3 +59,26 @@ def binned_device_bytes(n_rows: int, n_features: int, itemsize: int,
     bins plus (Pallas path) the same-size feature-major int8 tile."""
     return (int(n_rows) * int(n_features) * int(itemsize)
             * (2 if with_transposed else 1))
+
+
+def stacked_forest_bytes(n_trees: int, num_leaves: int,
+                         cat_bitset_words: int = 0) -> int:
+    """Device-resident footprint of one stacked forest
+    (``GBDT._stack_model_list`` layout): per tree, four ``[Ln]`` int32
+    node tables plus a bool default-left column and the ``[L]`` f32
+    leaf values (plus the categorical bitset planes when present).
+    The serve-side gates — the multi-model LRU's byte cap
+    (serve/registry.py) and the tree-shard auto policy
+    (serve/shard.py) — both budget against THIS estimate, keeping
+    their judgments of "how big is a resident model" from drifting
+    apart the way the dataset gates once did."""
+    T = max(int(n_trees), 0)
+    L = max(int(num_leaves), 1)
+    Ln = max(L - 1, 1)
+    per_tree = (Ln * 4 * 4      # split_feature/threshold/left/right i32
+                + Ln * 1        # default_left bool
+                + L * 4         # leaf_value f32
+                + 4 + 4)        # num_leaves + class index i32
+    if cat_bitset_words > 0:
+        per_tree += Ln * (1 + 4 * int(cat_bitset_words))  # is_cat+bitset
+    return T * per_tree

@@ -69,6 +69,15 @@ CHAOS_SMOKE=1
 JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
 python benchmarks/chaos_bench.py --smoke || CHAOS_SMOKE=0
 
+# serving smoke (docs/serving.md): N concurrent clients through the
+# micro-batching service with a 1-model LRU and a mid-traffic hot-swap
+# — zero dropped requests, zero warm-path compiles; its status rides
+# the obs line so scripts/obs_trend.py fails absolutely on
+# serve_smoke=0
+SERVE_SMOKE=1
+JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
+python benchmarks/serve_bench.py --smoke || SERVE_SMOKE=0
+
 # static analysis (docs/static-analysis.md): the five drift linters —
 # capability-gate / config-knobs / obs-names / collective-safety /
 # lock-discipline — must report ZERO findings. The count rides the obs
@@ -84,9 +93,9 @@ LINT_FINDINGS=$(cat "$LINT_COUNT_FILE" 2>/dev/null || echo -1)
 # dots/seconds from this run plus compile count and peak-HBM estimate
 # read back from the snapshot. A malformed dump FAILS the gate — a
 # check that silently skips its own telemetry is how telemetry rots.
-python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" <<'PY' >> scripts/check_timings.log
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" <<'PY' >> scripts/check_timings.log
 import json, sys, time
-path, mode, dots, secs, rev, stream_ok, chaos_ok, lint = sys.argv[1:9]
+path, mode, dots, secs, rev, stream_ok, chaos_ok, lint, serve_ok = sys.argv[1:10]
 try:
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     snap = json.loads(lines[-1])
@@ -122,6 +131,9 @@ print("obs " + json.dumps({
     "stream_dryrun": int(stream_ok),
     # kill + resume + hot-swap loop (benchmarks/chaos_bench.py --smoke)
     "chaos_smoke": int(chaos_ok),
+    # concurrent serving: coalesce + evict + swap under load with zero
+    # drops and zero warm compiles (benchmarks/serve_bench.py --smoke)
+    "serve_smoke": int(serve_ok),
     # drift-linter findings (python -m tools.analyze; -1 = analyzer
     # crashed). obs_trend.py fails absolutely on anything but 0
     "lint_findings": int(lint),
@@ -140,6 +152,11 @@ if [[ "$LINT_FINDINGS" != 0 ]]; then
   echo "check.sh: static analysis FAILED ($LINT_FINDINGS finding(s);" \
        "run python -m tools.analyze — docs/static-analysis.md)"
   exit 6
+fi
+if [[ "$SERVE_SMOKE" != 1 ]]; then
+  echo "check.sh: serving smoke FAILED (coalesce+evict+swap under" \
+       "load; status logged)"
+  exit 7
 fi
 
 # perf-regression sentinel (CHECK_TREND=1 to enforce): compare the obs

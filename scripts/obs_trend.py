@@ -23,6 +23,10 @@ regressed past its threshold —
 - ``chaos_smoke`` == 0 in the NEWEST run (absolute, like
   stream_dryrun): the kill + resume + hot-swap chaos smoke check.sh
   runs lost bit-equality, dropped a request, or crashed;
+- ``serve_smoke`` == 0 in the NEWEST run (absolute, like chaos_smoke):
+  the concurrent serving smoke (``benchmarks/serve_bench.py --smoke``
+  — coalesce + LRU-evict + mid-traffic hot-swap under load) dropped a
+  request, compiled a warm-path program, or crashed;
 - ``lint_findings`` != 0 in the NEWEST run (absolute): the static
   analysis suite (``python -m tools.analyze``;
   docs/static-analysis.md) reported drift findings — or crashed
@@ -137,6 +141,15 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
             "chaos smoke FAILED (chaos_smoke=0): kill + resume + "
             "hot-swap lost bit-equality or crashed "
             "(benchmarks/chaos_bench.py --smoke)")
+    # the serving smoke is absolute the same way: a dropped request or
+    # a warm-path compile under coalesce + evict + swap load is broken
+    # NOW, whatever the trailing median says
+    if _num(newest, "serve_smoke") == 0.0:
+        failures.append(
+            "serving smoke FAILED (serve_smoke=0): concurrent "
+            "coalesce + LRU-evict + mid-traffic-swap load dropped a "
+            "request, compiled a warm-path program, or crashed "
+            "(benchmarks/serve_bench.py --smoke)")
     # static analysis is absolute the same way: findings are drift
     # bugs NOW (gate literal outside the capability table, raw knob
     # read, collective inside a lax.switch branch...), and -1 means

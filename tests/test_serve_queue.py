@@ -1,0 +1,415 @@
+"""Serving service (lightgbm_tpu/serve/; docs/serving.md): queue,
+micro-batching, SLO wiring, and the hot-swap threading contract.
+
+What these tests pin:
+
+* **Coalescing** — concurrent submits for one model ride ONE bucketed
+  dispatch under the latency budget; per-request results are sliced
+  back exactly (bit-equal to a direct ``Booster.predict``).
+* **Flush rules** — the budget cutoff dispatches a lone request
+  promptly; the row cap flushes a filling batch early.
+* **SLO plane** — ``slo.queue_depth`` is the REAL queue depth via the
+  registered provider (not the PR 11 placeholder), and ``/readyz``
+  turns green only after the service's warmup predict (the PR 13
+  readiness-by-warmup contract).
+* **Swap lock** — serving.ModelWatcher.swap_lock serializes swaps
+  against predicts for real: a mid-traffic publish under concurrent
+  client threads yields every request bit-equal to the OLD or the NEW
+  model, never a mid-swap hybrid, with zero dropped requests — also
+  under LRU eviction churn through the service.
+"""
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import slo as _slo
+from lightgbm_tpu.obs.server import health_payload
+from lightgbm_tpu.serve import PredictService
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _data(n=2000, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 8, "verbosity": -1}
+
+
+@pytest.fixture(scope="module")
+def trained():
+    X, y = _data()
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=4)
+    return bst, X
+
+
+def _service(start=True, **over):
+    p = {"tpu_serve_batch_budget_ms": 200.0,
+         "tpu_serve_max_batch_rows": 1024,
+         "tpu_serve_shard_trees": "false"}
+    p.update(over)
+    return PredictService(p, start=start)
+
+
+def test_coalesce_one_dispatch_exact_results(trained):
+    bst, X = trained
+    obs.enable(metrics=True)
+    svc = _service()
+    try:
+        svc.add_model("m", bst)
+        Xq = X[:96]
+        direct = bst.predict(Xq)
+        futs = [svc.submit("m", Xq) for _ in range(5)]
+        outs = [f.result(timeout=20) for f in futs]
+        for out in outs:
+            np.testing.assert_array_equal(out, direct)
+        reg = obs.registry()
+        assert reg.get("serve.dispatches").value == 1.0
+        assert reg.get("serve.coalesced_requests").value == 5.0
+        # 5 x 96 = 480 rows in a 512 bucket
+        assert reg.get("serve.batch_fill_ratio").value == \
+            pytest.approx(480 / 512)
+    finally:
+        svc.close()
+
+
+def test_budget_flush_lone_request(trained):
+    bst, X = trained
+    svc = _service(tpu_serve_batch_budget_ms=10.0)
+    try:
+        svc.add_model("m", bst)
+        t0 = time.monotonic()
+        out = svc.predict("m", X[:10], timeout=20)
+        assert time.monotonic() - t0 < 15.0
+        np.testing.assert_array_equal(out, bst.predict(X[:10]))
+    finally:
+        svc.close()
+
+
+def test_row_cap_flushes_early(trained):
+    bst, X = trained
+    obs.enable(metrics=True)
+    # a 10 s budget would stall the test if fill did not flush
+    svc = _service(tpu_serve_batch_budget_ms=10_000.0,
+                   tpu_serve_max_batch_rows=256)
+    try:
+        svc.add_model("m", bst)
+        futs = [svc.submit("m", X[:128]) for _ in range(4)]
+        for f in futs:
+            f.result(timeout=20)
+        assert obs.registry().get("serve.dispatches").value == 2.0
+        assert obs.registry().get(
+            "serve.coalesced_requests").value == 4.0
+    finally:
+        svc.close()
+
+
+def test_oversized_request_dispatches_alone(trained):
+    bst, X = trained
+    svc = _service(tpu_serve_max_batch_rows=128)
+    try:
+        svc.add_model("m", bst)
+        out = svc.predict("m", X[:300], timeout=30)
+        np.testing.assert_array_equal(out, bst.predict(X[:300]))
+    finally:
+        svc.close()
+
+
+def test_malformed_rider_does_not_poison_batchmates(trained):
+    """A rider whose payload cannot even concatenate (wrong column
+    count) fails ALONE — its well-formed batchmates still resolve."""
+    bst, X = trained
+    svc = _service(tpu_serve_batch_budget_ms=200.0)
+    try:
+        svc.add_model("m", bst)
+        good = svc.submit("m", X[:16])
+        bad = svc.submit("m", X[:8, :4])
+        np.testing.assert_array_equal(good.result(timeout=20),
+                                      bst.predict(X[:16]))
+        with pytest.raises(Exception):
+            bad.result(timeout=20)
+    finally:
+        svc.close()
+
+
+def test_prefix_pop_strict_fifo_with_oversize():
+    """A request that does not fit the cap ENDS the batch — later
+    same-model requests never overtake it (pure queue, no engine)."""
+    from lightgbm_tpu.serve.queue import MicroBatchQueue
+    q = MicroBatchQueue(budget_s=0.0, max_batch_rows=1024)
+    q.submit("m", np.zeros((100, 2)))
+    q.submit("m", np.zeros((2000, 2)))
+    q.submit("m", np.zeros((50, 2)))
+    assert q.depth() == 3
+    _, b1 = q.next_batch()
+    assert [r.rows for r in b1] == [100]    # prefix ends at r2
+    _, b2 = q.next_batch()
+    assert [r.rows for r in b2] == [2000]   # oversize dispatches alone
+    _, b3 = q.next_batch()
+    assert [r.rows for r in b3] == [50]     # ... and r3 never overtook
+    assert q.depth() == 0
+
+
+def test_frozen_prefix_flushes_without_waiting_budget():
+    """Once a non-fitting request freezes the prefix, strict FIFO
+    means nothing can ever join the batch — dispatch immediately
+    instead of burning the whole latency budget (and delaying the
+    blocked request behind it)."""
+    from lightgbm_tpu.serve.queue import MicroBatchQueue
+    q = MicroBatchQueue(budget_s=30.0, max_batch_rows=1024)
+    q.submit("m", np.zeros((100, 2)))
+    q.submit("m", np.zeros((2000, 2)))   # freezes the prefix at 100
+    t0 = time.monotonic()
+    _, batch = q.next_batch(poll_s=0.05)
+    assert time.monotonic() - t0 < 5.0   # nowhere near the 30s budget
+    assert [r.rows for r in batch] == [100]
+
+
+def test_unknown_model_fails_future_not_silently(trained):
+    bst, X = trained
+    svc = _service(tpu_serve_batch_budget_ms=0.0)
+    try:
+        fut = svc.submit("nope", X[:8])
+        with pytest.raises(KeyError):
+            fut.result(timeout=20)
+    finally:
+        svc.close()
+
+
+def test_cancelled_rider_does_not_poison_batchmates(trained):
+    """A client that cancels its queued future (e.g. after a timeout)
+    must not break the batchmates coalesced with it — their results
+    still land."""
+    bst, X = trained
+    svc = _service(start=False, tpu_serve_batch_budget_ms=50.0)
+    try:
+        svc.add_model("m", bst)
+        doomed = svc.submit("m", X[:16])
+        keeper = svc.submit("m", X[:16])
+        assert doomed.cancel()          # still queued: cancellable
+        svc.start()
+        out = keeper.result(timeout=20)
+        np.testing.assert_array_equal(out, bst.predict(X[:16]))
+    finally:
+        svc.close()
+
+
+def test_close_only_clears_own_slo_provider(trained):
+    """Blue/green in one process: closing the OLD service must not
+    zero the queue-depth provider the NEW service registered."""
+    bst, X = trained
+    obs.enable(metrics=True, slo=True)
+    old = _service(start=False)
+    new = _service(start=False)
+    try:
+        new.add_model("m", bst)
+        new.submit("m", X[:8])
+        old.close()
+        assert _slo.tracker().compute()["slo.queue_depth"] == 1.0
+    finally:
+        new.close()
+    assert _slo.tracker().compute()["slo.queue_depth"] == 0.0
+
+
+def test_close_fails_queued_futures(trained):
+    bst, X = trained
+    svc = _service(start=False)
+    svc.add_model("m", bst)
+    fut = svc.submit("m", X[:8])
+    svc.close()
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=5)
+    with pytest.raises(RuntimeError):
+        svc.submit("m", X[:8])
+
+
+def test_queue_depth_feeds_slo_gauge(trained):
+    bst, X = trained
+    obs.enable(metrics=True, slo=True)
+    svc = _service(start=False)   # no dispatcher: depth stays visible
+    try:
+        svc.add_model("m", bst)
+        for _ in range(3):
+            svc.submit("m", X[:8])
+        slis = _slo.tracker().compute()
+        assert slis["slo.queue_depth"] == 3.0
+        # the gauge lands in the registry through evaluate()
+        _slo.tracker().evaluate()
+        assert obs.registry().get("slo.queue_depth").value == 3.0
+    finally:
+        svc.close()
+    # provider unregistered on close: back to the empty-queue reading
+    assert _slo.tracker().compute()["slo.queue_depth"] == 0.0
+
+
+def test_readyz_green_after_warmup(trained):
+    bst, X = trained
+    obs.enable(metrics=True)
+    code, body = health_payload(ready=True, timeout_s=60.0)
+    assert code == 503      # no heartbeat yet: not ready
+    svc = _service()
+    try:
+        svc.add_model("m", bst)
+        svc.warmup("m", X[:1])
+        code, body = health_payload(ready=True, timeout_s=60.0)
+        assert code == 200
+        assert "serve" in body["heartbeats"]
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the hot-swap threading contract (satellite: a REAL swap lock)
+# ---------------------------------------------------------------------------
+def _stage_checkpoint(X, y, tmp_path, rounds=6):
+    """Pre-train a publishable v2 checkpoint into a staging dir (more
+    rounds than the serving model, so the swap visibly changes
+    predictions — deterministic training makes a same-round retrain
+    identical)."""
+    stage = str(tmp_path / "stage")
+    lgb.train(dict(PARAMS, checkpoint_dir=stage,
+                   checkpoint_interval=rounds),
+              lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return stage
+
+
+def _publish(stage, pub):
+    os.makedirs(pub, exist_ok=True)
+    names = sorted(os.listdir(stage))
+    for name in names:
+        if not name.startswith("latest."):
+            shutil.copy(os.path.join(stage, name),
+                        os.path.join(pub, name))
+    for name in names:
+        if name.startswith("latest."):
+            shutil.copy(os.path.join(stage, name),
+                        os.path.join(pub, name))
+
+
+def test_swap_lock_exists_and_reentrant(trained, tmp_path):
+    bst_, _X = trained
+    from lightgbm_tpu.serving import ModelWatcher
+    w = ModelWatcher(str(tmp_path), interval=0.0)
+    assert w.swap_lock.acquire(blocking=False)
+    assert w.swap_lock.acquire(blocking=False)   # reentrant
+    w.swap_lock.release()
+    w.swap_lock.release()
+
+
+def test_concurrent_swap_under_load_old_or_new_only(tmp_path):
+    """N threads hammer Booster.predict while a checkpoint publishes:
+    with the swap lock, every result is bit-equal to the OLD or the
+    NEW model's output — never a mid-swap hybrid — and nothing drops."""
+    X, y = _data(seed=3)
+    server = lgb.train(PARAMS, lgb.Dataset(X, label=y),
+                       num_boost_round=4)
+    v2 = lgb.train(PARAMS, lgb.Dataset(X, label=y),
+                   num_boost_round=6)
+    stage = _stage_checkpoint(X, y, tmp_path, rounds=6)
+    pub = str(tmp_path / "pub")
+    os.makedirs(pub)
+    server.watch_checkpoints(pub, interval=0.0)
+    Xq = X[:64]
+    old = server.predict(Xq)
+    new = v2.predict(Xq)
+    assert not np.array_equal(old, new)   # the swap must be visible
+
+    results, errors = [], []
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            try:
+                results.append(server.predict(Xq))
+            except Exception as e:      # noqa: BLE001 - a drop IS the bug
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    _publish(stage, pub)
+    deadline = time.monotonic() + 10.0
+    while (server._model_watch.swaps < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=20)
+
+    assert not errors, f"dropped {len(errors)} request(s): {errors[:3]}"
+    assert server._model_watch.swaps >= 1
+    for r in results:
+        assert (np.array_equal(r, old) or np.array_equal(r, new)), \
+            "a predict observed a mid-swap engine"
+    # and post-swap serving equals the published model exactly
+    np.testing.assert_array_equal(server.predict(Xq), new)
+
+
+def test_service_swap_plus_eviction_race_zero_drops(tmp_path):
+    """The satellite race: mid-traffic hot-swap AND LRU eviction churn
+    (1-model cache, two tenants) through the service — every future
+    resolves, the swap lands, evictions happen."""
+    X, y = _data(seed=4)
+    obs.enable(metrics=True)
+    bA = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=4)
+    bB = lgb.train(dict(PARAMS, seed=1), lgb.Dataset(X, label=y),
+                   num_boost_round=4)
+    stage = _stage_checkpoint(X, y, tmp_path)
+    pub = str(tmp_path / "pub")
+    os.makedirs(pub)
+    svc = _service(tpu_serve_batch_budget_ms=1.0,
+                   tpu_serve_cache_models=1)
+    try:
+        svc.add_model("a", bA, watch_dir=pub, watch_interval=0.0)
+        svc.add_model("b", bB)
+        svc.warmup("a", X[:1])
+        svc.warmup("b", X[:1])
+        done, errors = [], []
+        stop = threading.Event()
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                mid = "a" if rng.integers(0, 2) else "b"
+                try:
+                    done.append(svc.predict(mid, X[:32], timeout=30))
+                except Exception as e:  # noqa: BLE001 - a drop IS the bug
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        _publish(stage, pub)
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=20)
+        assert not errors, f"dropped {len(errors)}: {errors[:3]}"
+        assert done and all(np.shape(d)[0] == 32 for d in done)
+        assert bA._model_watch.swaps >= 1
+        assert obs.registry().get("serve.evictions").value >= 1.0
+    finally:
+        svc.close()

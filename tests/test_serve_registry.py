@@ -1,0 +1,240 @@
+"""Multi-tenant LRU of device forests (serve/registry.py).
+
+What these tests pin (the registry satellite checklist):
+
+* **LRU order** — admission past ``tpu_serve_cache_models`` evicts the
+  least-recently-CHECKED-OUT model, and a checkout refreshes recency.
+* **Byte cap** — an explicit ``tpu_serve_cache_bytes`` (and the auto
+  cap derived from a mocked ``hbm_bytes_limit``) evicts by the shared
+  utils/hbm.py ``stacked_forest_bytes`` estimate; one model alone over
+  the cap still serves.
+* **Buffer release** — eviction actually releases device buffers:
+  the process live-buffer count drops once the stacked forest is
+  dropped and collected.
+* **Zero-recompile re-admission** — a predict after evict+checkout
+  re-stacks (CompileWatch sees ZERO compile requests, the stack-build
+  counter moves).
+* **Hot-swap identity** — a ModelWatcher swap bumps the stack key and
+  the entry is re-costed on its next checkout, not trusted stale.
+"""
+import gc
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.serve import ModelRegistry
+from lightgbm_tpu.utils.debug import CompileWatch
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+PARAMS = {"objective": "binary", "num_leaves": 8, "verbosity": -1}
+
+
+def _data(n=1500, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(np.float64)
+    return X, y
+
+
+def _boosters(k, rounds=3):
+    X, y = _data()
+    return X, [lgb.train(dict(PARAMS, seed=i),
+                         lgb.Dataset(X, label=y),
+                         num_boost_round=rounds) for i in range(k)]
+
+
+def _registry(**over):
+    p = {"tpu_serve_shard_trees": "false"}
+    p.update(over)
+    return ModelRegistry(p)
+
+
+def test_lru_eviction_order():
+    obs.enable(metrics=True)
+    X, (a, b, c) = _boosters(3)
+    reg = _registry(tpu_serve_cache_models=2)
+    for mid, bst in (("a", a), ("b", b), ("c", c)):
+        reg.register(mid, bst)
+    reg.checkout("a")
+    reg.checkout("b")
+    assert sorted(reg.resident_ids()) == ["a", "b"]
+    reg.checkout("c")                       # a is LRU -> evicted
+    assert sorted(reg.resident_ids()) == ["b", "c"]
+    reg.checkout("b")                       # refresh b's recency
+    reg.checkout("a")                       # now c is LRU
+    assert sorted(reg.resident_ids()) == ["a", "b"]
+    assert obs.registry().get("serve.evictions").value == 2.0
+    # hits only where the forest was already resident
+    assert obs.registry().get("serve.cache_hits").value == 1.0
+    assert obs.registry().get("serve.cache_models").value == 2.0
+
+
+def test_byte_cap_explicit():
+    X, (a, b) = _boosters(2)
+    reg0 = _registry()
+    reg0.register("a", a)
+    reg0.checkout("a")
+    est = reg0.resident_bytes()
+    assert est > 0
+    # cap fits ONE model, not two
+    reg = _registry(tpu_serve_cache_bytes=int(est * 1.5),
+                    tpu_serve_cache_models=8)
+    reg.register("a", a)
+    reg.register("b", b)
+    reg.checkout("a")
+    reg.checkout("b")
+    assert reg.resident_ids() == ["b"]
+    assert reg.resident_bytes() <= int(est * 1.5)
+
+
+def test_byte_cap_auto_from_mocked_hbm_limit(monkeypatch):
+    X, (a, b) = _boosters(2)
+    probe = _registry()
+    probe.register("a", a)
+    probe.checkout("a")
+    est = probe.resident_bytes()
+    # auto cap = SERVE_HBM_FRACTION * limit; mock the limit so the
+    # fraction admits exactly one model
+    from lightgbm_tpu.serve import registry as reg_mod
+    from lightgbm_tpu.utils.hbm import SERVE_HBM_FRACTION
+    monkeypatch.setattr(reg_mod, "hbm_bytes_limit",
+                        lambda: int(est * 1.5 / SERVE_HBM_FRACTION))
+    reg = _registry(tpu_serve_cache_bytes=0)
+    assert reg.max_bytes == pytest.approx(est * 1.5, rel=0.01)
+    reg.register("a", a)
+    reg.register("b", b)
+    reg.checkout("a")
+    reg.checkout("b")
+    assert reg.resident_ids() == ["b"]
+
+
+def test_single_model_over_cap_still_serves():
+    X, (a,) = _boosters(1)
+    reg = _registry(tpu_serve_cache_bytes=16)   # absurdly small
+    reg.register("a", a)
+    bst = reg.checkout("a")
+    np.testing.assert_array_equal(bst.predict(X[:32]),
+                                  a.predict(X[:32]))
+    assert reg.resident_ids() == ["a"]
+
+
+def test_eviction_releases_device_buffers():
+    import jax
+    X, (a,) = _boosters(1, rounds=4)
+    reg = _registry()
+    reg.register("a", a)
+    reg.checkout("a").predict(X[:128])      # stack resident + warm
+    gc.collect()
+    n_before = len(jax.live_arrays())
+    assert a.engine._stack_cache is not None
+    reg.evict("a")
+    gc.collect()
+    n_after = len(jax.live_arrays())
+    assert a.engine._stack_cache is None
+    # the stacked forest is >= 7 arrays; require a real drop, with
+    # slack for unrelated churn
+    assert n_after <= n_before - 5, \
+        f"live buffers {n_before} -> {n_after}: eviction leaked"
+
+
+def test_readmission_recompiles_nothing():
+    obs.enable(metrics=True)
+    X, (a,) = _boosters(1)
+    reg = _registry(tpu_serve_cache_models=1)
+    reg.register("a", a)
+    reg.checkout("a").predict(X[:128])
+    reg.checkout("a").predict(X[:128])      # warm
+    builds_before = a.engine._stack_builds
+    reg.evict("a")
+    with CompileWatch("readmit") as w:
+        out = reg.checkout("a").predict(X[:128])
+    w.assert_compiles(0)
+    assert a.engine._stack_builds == builds_before + 1  # re-stack, yes
+    np.testing.assert_array_equal(out, a.predict(X[:128]))
+
+
+def test_swap_bumps_identity_and_recosts():
+    X, (a,) = _boosters(1)
+    reg = _registry()
+    reg.register("a", a)
+    reg.checkout("a")
+    entry = reg._entries["a"]
+    key0, bytes0 = entry.key, entry.bytes
+    # a hot-swap path mutates the model list + version
+    eng = a.engine
+    eng.models = eng.models + eng.models          # pretend bigger model
+    eng._invalidate_forest_cache()
+    reg.checkout("a")
+    assert entry.key != key0
+    assert entry.bytes > bytes0
+
+
+def test_swap_reruns_shard_policy(monkeypatch):
+    """A hot-swap can grow a forest past the single-device auto
+    threshold: admission must re-run ``auto_shard_mesh``, not trust
+    the placement decided at register() time (hits must not)."""
+    from lightgbm_tpu.serve import registry as reg_mod
+    calls = []
+    monkeypatch.setattr(reg_mod, "auto_shard_mesh",
+                        lambda bst, cfg: calls.append(1))
+    X, (a,) = _boosters(1)
+    reg = _registry()
+    reg.register("a", a)
+    assert len(calls) == 1                  # register-time policy
+    reg.checkout("a")
+    assert len(calls) == 2                  # first admission
+    reg.checkout("a")
+    assert len(calls) == 2                  # cache hit: no re-eval
+    eng = a.engine
+    eng.models = eng.models + eng.models    # hot-swap grows the model
+    eng._invalidate_forest_cache()
+    reg.checkout("a")
+    assert len(calls) == 3                  # version bump: re-eval
+    reg.evict("a")
+    reg.checkout("a")
+    assert len(calls) == 4                  # re-admission: re-eval
+
+
+def test_register_replacing_resident_releases_old():
+    obs.enable(metrics=True)
+    X, (a, b) = _boosters(2)
+    reg = _registry()
+    reg.register("m", a)
+    reg.checkout("m").predict(X[:128])
+    assert a.engine._stack_cache is not None
+    reg.register("m", b)                    # tenant republished
+    assert a.engine._stack_cache is None    # old device forest freed
+    # a deploy refresh is NOT cache pressure: no eviction counted
+    ev = obs.registry().get("serve.evictions")
+    assert ev is None or ev.value == 0.0
+    np.testing.assert_array_equal(reg.checkout("m").predict(X[:32]),
+                                  b.predict(X[:32]))
+
+
+def test_register_refresh_lands_most_recent():
+    """Re-registering a model must not leave it at its OLD LRU slot
+    where the next admission would evict the fresh deploy first."""
+    X, (a, b, c) = _boosters(3)
+    reg = _registry(tpu_serve_cache_models=2)
+    reg.register("a", a)
+    reg.register("b", b)
+    reg.checkout("a")
+    reg.checkout("b")
+    reg.register("a", c)                    # refresh tenant a
+    reg.checkout("a")                       # re-admit the refresh
+    reg.register("c", c)
+    reg.checkout("c")                       # b is LRU now, not a
+    assert sorted(reg.resident_ids()) == ["a", "c"]

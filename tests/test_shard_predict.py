@@ -1,0 +1,190 @@
+"""Tree-sharded predict (serve/shard.py + ops/predict.py).
+
+What these tests pin (on the conftest 8-fake-CPU-device mesh):
+
+* **Bit-identity** — predicts with the stacked tree axis
+  NamedSharding-split over a 2-device (and 8-device) mesh are
+  ``array_equal`` to the single-device path: binary, multiclass
+  (sequential class accumulation preserved), pred_leaf, raw_score,
+  and num_iteration slices.
+* **Warm path** — repeat sharded predicts re-place nothing and
+  compile nothing (CompileWatch), and hot-swap under sharding stays
+  zero-recompile.
+* **Capability routing** — every engine has a SHARDED_PREDICT row;
+  DART / streaming / linear_tree / model-file boosters DEMOTE to the
+  unsharded path (enable returns None, serving continues).
+* **Policy** — ``tpu_serve_shard_trees`` false/true/auto behave per
+  docs/serving.md (auto gates on the shared HBM estimate).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import capabilities, obs
+from lightgbm_tpu.serve.shard import (auto_shard_mesh,
+                                      enable_tree_sharding, tree_mesh)
+from lightgbm_tpu.utils.debug import CompileWatch
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _data(n=2500, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+BIN = {"objective": "binary", "num_leaves": 8, "verbosity": -1}
+
+
+@pytest.fixture(scope="module")
+def query():
+    rng = np.random.default_rng(7)
+    return rng.normal(size=(600, 10))
+
+
+def test_bit_identical_binary_2_and_8_devices(query):
+    X, y = _data()
+    bst = lgb.train(BIN, lgb.Dataset(X, label=y), num_boost_round=7)
+    base = bst.predict(query)
+    base_raw = bst.predict(query, raw_score=True)
+    base_leaf = bst.predict(query, pred_leaf=True)
+    for d in (2, 8):
+        mesh = enable_tree_sharding(bst, tree_mesh(d))
+        assert mesh is not None and int(mesh.devices.size) == d
+        np.testing.assert_array_equal(bst.predict(query), base)
+        np.testing.assert_array_equal(
+            bst.predict(query, raw_score=True), base_raw)
+        np.testing.assert_array_equal(
+            bst.predict(query, pred_leaf=True), base_leaf)
+
+
+def test_bit_identical_multiclass_and_slices(query):
+    X, _ = _data(seed=1)
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 3, size=len(X)).astype(np.float64)
+    p = {"objective": "multiclass", "num_class": 3, "num_leaves": 8,
+         "verbosity": -1}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+    base = bst.predict(query)
+    base_slice = bst.predict(query, num_iteration=3)
+    mesh = enable_tree_sharding(bst, tree_mesh(2))
+    assert mesh is not None
+    np.testing.assert_array_equal(bst.predict(query), base)
+    # early-stop style slice: padded tree count stays mesh-divisible
+    np.testing.assert_array_equal(bst.predict(query, num_iteration=3),
+                                  base_slice)
+
+
+def test_sharded_warm_path_compiles_nothing(query):
+    X, y = _data(seed=2)
+    bst = lgb.train(BIN, lgb.Dataset(X, label=y), num_boost_round=6)
+    enable_tree_sharding(bst, tree_mesh(2))
+    bst.predict(query)
+    builds = bst.engine._stack_builds
+    with CompileWatch("sharded-warm") as w:
+        bst.predict(query)
+    w.assert_compiles(0)
+    assert bst.engine._stack_builds == builds   # cached sharded stack
+
+
+def test_capability_rows_cover_every_engine():
+    for eng in capabilities.ENGINES:
+        assert eng in capabilities.SHARDED_PREDICT
+        assert capabilities.SHARDED_PREDICT[eng] in (
+            capabilities.SUPPORTED, capabilities.DEMOTE)
+    assert capabilities.sharded_predict_verdict("gbdt") \
+        == capabilities.SUPPORTED
+    assert capabilities.sharded_predict_verdict("dart") \
+        == capabilities.DEMOTE
+    assert capabilities.sharded_predict_verdict("streaming") \
+        == capabilities.DEMOTE
+    # unknown engines demote (serve unsharded), never crash
+    assert capabilities.sharded_predict_verdict("future_engine") \
+        == capabilities.DEMOTE
+
+
+def test_dart_and_linear_demote_unsharded():
+    X, y = _data(seed=3)
+    dart = lgb.train(dict(BIN, boosting="dart"),
+                     lgb.Dataset(X, label=y), num_boost_round=4)
+    assert enable_tree_sharding(dart, tree_mesh(2)) is None
+    assert dart.engine._predict_mesh is None
+
+    lin = lgb.train(dict(BIN, linear_tree=True),
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert capabilities.sharded_predict_verdict(
+        "gbdt", lin.engine.config) == capabilities.DEMOTE
+    assert enable_tree_sharding(lin, tree_mesh(2)) is None
+
+
+def test_model_file_booster_demotes():
+    X, y = _data(seed=4)
+    bst = lgb.train(BIN, lgb.Dataset(X, label=y), num_boost_round=3)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    assert enable_tree_sharding(loaded, tree_mesh(2)) is None
+    # ... and still predicts (host-model path)
+    assert loaded.predict(X[:16]).shape == (16,)
+
+
+def test_registry_cache_hits_under_sharding(query):
+    """Shard enablement bumps the model version ONCE: re-applying the
+    policy (every LRU admission runs it) must be a no-op, so warm
+    checkouts are cache HITS, not an endless re-stack/re-upload
+    admission loop (the smoke runs unsharded — pin it here)."""
+    from lightgbm_tpu.serve import ModelRegistry
+    obs.enable(metrics=True)
+    X, y = _data(seed=6)
+    bst = lgb.train(BIN, lgb.Dataset(X, label=y), num_boost_round=4)
+    reg = ModelRegistry({"tpu_serve_shard_trees": "true"})
+    reg.register("m", bst)
+    assert bst.engine._predict_mesh is not None
+    ver = bst.engine._models_version
+    reg.checkout("m").predict(query)
+    reg.checkout("m")
+    reg.checkout("m")
+    assert bst.engine._models_version == ver    # policy re-runs: no-op
+    assert obs.registry().get("serve.cache_hits").value == 2.0
+    builds = bst.engine._stack_builds
+    reg.checkout("m").predict(query)            # warm: cached stack
+    assert bst.engine._stack_builds == builds
+
+
+def test_policy_knob_false_true_auto(monkeypatch, query):
+    X, y = _data(seed=5)
+    from lightgbm_tpu.config import Config
+    bst = lgb.train(BIN, lgb.Dataset(X, label=y), num_boost_round=4)
+    assert auto_shard_mesh(
+        bst, Config({"tpu_serve_shard_trees": "false"})) is None
+    assert bst.engine._predict_mesh is None
+
+    # auto with no reported HBM limit: stay unsharded
+    from lightgbm_tpu.serve import shard as shard_mod
+    monkeypatch.setattr(shard_mod, "hbm_bytes_limit", lambda: None)
+    assert auto_shard_mesh(
+        bst, Config({"tpu_serve_shard_trees": "auto"})) is None
+
+    # auto with a tiny mocked limit: the estimate exceeds the fraction
+    monkeypatch.setattr(shard_mod, "hbm_bytes_limit", lambda: 64)
+    mesh = auto_shard_mesh(
+        bst, Config({"tpu_serve_shard_trees": "auto"}))
+    assert mesh is not None
+    np.testing.assert_array_equal(bst.predict(query),
+                                  bst.predict(query))
+
+    bst2 = lgb.train(BIN, lgb.Dataset(X, label=y), num_boost_round=4)
+    base = bst2.predict(query)
+    mesh = auto_shard_mesh(
+        bst2, Config({"tpu_serve_shard_trees": "true"}))
+    assert mesh is not None
+    np.testing.assert_array_equal(bst2.predict(query), base)
