@@ -175,11 +175,25 @@ def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
     if warmup > iters:
         eng.train_chunk(min(iters, warmup))
         jax.block_until_ready(eng.score)
+    # --profile-dir: jax.profiler trace around the FIRST timed window
+    # (the steady state, matching the r5 attribution protocol), then
+    # the raw-XSpace attribution feeds train.copy_share /
+    # train.wall_busy_gap_ms — read back off the one snapshot below
+    prof_dir = str(getattr(cfg, "tpu_profile_dir", "") or "").strip()
+    if prof_dir:
+        jax.profiler.start_trace(prof_dir)
     rates = []
     t0 = time.time()
     eng.train_chunk(iters)
     jax.block_until_ready(eng.score)
-    rates.append(iters / (time.time() - t0))
+    window_s = time.time() - t0
+    rates.append(iters / window_s)
+    if prof_dir:
+        # wall measured BEFORE stop_trace: writing the dump to disk is
+        # not part of the traced window's wall time
+        jax.profiler.stop_trace()
+        from lightgbm_tpu.obs.trace_attr import profile_gauges
+        profile_gauges(prof_dir, iters=iters, wall_ms=window_s * 1e3)
     # held-out AUC at the fixed warmup+iters round count (equal across
     # configs), between the timed windows so it inflates none of them
     pred = eng.predict(X_ho)
@@ -249,6 +263,19 @@ def main():
                          "(tpu_compile_cache_dir): a second run "
                          "reloads programs instead of recompiling — "
                          "watch ttfi_s collapse")
+    ap.add_argument("--no-donate", dest="donate", action="store_false",
+                    default=True,
+                    help="disable boosting-carry buffer donation "
+                         "(tpu_donate=false) — the A/B arm for the "
+                         "loop-state %%copy squeeze (docs/perf.md "
+                         "'Iteration floor'); the metric line tags "
+                         "donate=off")
+    ap.add_argument("--profile-dir", type=str, default="",
+                    help="jax.profiler trace dir for the first timed "
+                         "window (tpu_profile_dir); the raw-XSpace "
+                         "attribution (scripts/trace_attr.py) feeds "
+                         "copy_share= / wall_busy_gap_ms= on the "
+                         "metric line")
     ap.add_argument("--no-guard2", dest="guard2", action="store_false",
                     default=True)
     ap.add_argument("--no-plain1m", dest="plain1m",
@@ -305,6 +332,10 @@ def main():
         params["tpu_ingest_device"] = ("true" if args.ingest == "device"
                                        else "false")
     params["tpu_hist_partition"] = args.partition
+    if not args.donate:
+        params["tpu_donate"] = "false"
+    if args.profile_dir:
+        params["tpu_profile_dir"] = args.profile_dir
     if args.compile_cache:
         params["tpu_compile_cache_dir"] = args.compile_cache
     from lightgbm_tpu import obs
@@ -411,6 +442,19 @@ def main():
                f"{_snap_gauge(snap, 'bench.predict_rps'):.0f}")
     v = _snap_gauge(snap, "bench.hist_partition")
     extras += f"; partition={'on' if v else 'off'}"
+    if not args.donate:
+        # the --no-donate A/B arm tags itself so a pasted metric line
+        # can never pass an undonated number off as the flagship
+        extras += "; donate=off"
+    v = _snap_gauge(snap, "train.copy_share")
+    if v is not None:
+        # --profile-dir attribution (scripts/trace_attr.py): fraction
+        # of device busy in loop-state %copy ops — the signal the
+        # donation pass squeezes — plus the per-iter wall-vs-busy gap
+        extras += f"; copy_share={v:.4f}"
+        g = _snap_gauge(snap, "train.wall_busy_gap_ms")
+        if g is not None:
+            extras += f"; wall_busy_gap_ms={g:.2f}"
     v = _snap_gauge(snap, "hist.rows_scanned")
     if v:
         # the structural win the partition exists for: total rows the

@@ -33,6 +33,12 @@ from .gbdt import GBDT
 class DART(GBDT):
     """DART engine (reference: src/boosting/dart.hpp DART : public GBDT)."""
 
+    # no carry donation (tpu_donate): train_one_iter holds
+    # score_pre/valid_pre across the boosting step and blends the new
+    # tree's contribution against them AFTER dispatch — donating would
+    # delete exactly those buffers (docs/perf.md "Iteration floor")
+    _donate_carries = False
+
     def __init__(self, config, train_set, fobj=None, mesh=None,
                  init_forest=None):
         super().__init__(config, train_set, fobj=fobj, mesh=mesh,

@@ -334,6 +334,13 @@ def goss_shard_valid_counts(n_local: int, n_pad_local: int,
 class GBDT:
     """Boosting engine (reference: GBDT class, src/boosting/gbdt.cpp)."""
 
+    # score/valid-score carries may donate under tpu_donate (the step
+    # outputs fully replace the inputs, nothing host-side re-reads the
+    # pre-step buffers). DART re-reads score_pre/valid_pre to rescale
+    # the new tree against the dropped set, and RF folds the step
+    # output against held base/pred-sum buffers — both override False.
+    _donate_carries = True
+
     def __init__(self, config: Config, train_set: Dataset,
                  fobj: Optional[Callable] = None, mesh=None,
                  init_forest=None):
@@ -1464,6 +1471,32 @@ class GBDT:
         # shape the grow loop actually repartitions: the compacted
         # buffer under GOSS hist-compact, the full padded rows otherwise
         self._goss_n_sub = n_sub if use_goss_compact else None
+
+        # ---- buffer donation (tpu_donate; docs/perf.md "Iteration
+        # floor"): the r5 trace pins ~9% of device busy on loop-state
+        # %copy — donate the carries so XLA aliases them in place.
+        # The [n_pad, K] score carry is donation-safe only when no
+        # host path re-reads the PRE-step buffer after dispatch:
+        # leaf-output renewal reads the old score for its percentile
+        # refit, linear leaves read score_pre in _apply_linear_fit,
+        # and DART/RF blend with held pre-step score/valid buffers
+        # (those engines set _donate_carries=False).
+        from ..utils.debug import donation_enabled, donation_guard
+        _donate = donation_enabled(self.config)
+        _donate_score = (_donate and self._donate_carries
+                         and not renews_obj and not self.linear_tree)
+        _donate_valid = _donate and self._donate_carries
+        _dbg_checks = bool(self.config.tpu_debug_checks)
+
+        def _jit_don(fn, don, site):
+            # jit with donation; tpu_debug_checks wraps DONATING jits
+            # in the use-after-donate guard — a jit that donates
+            # nothing cannot use-after-donate, and wrapping it would
+            # only misattribute an unrelated deleted-array error to
+            # this site (plus pay a per-call leaf scan for nothing)
+            j = jax.jit(fn, donate_argnums=don)
+            return donation_guard(j, site) if (don and _dbg_checks) \
+                else j
         if use_goss_compact:
             dd = self.data
             n_full = dd.n_pad
@@ -1552,11 +1585,13 @@ class GBDT:
 
             # donate cegb_U so the lazy-acquisition matrix updates in
             # place ([n_pad, F_pad] bool — 2.5 GB at 10M x 256) instead
-            # of holding two copies across the step (CPU ignores
-            # donation with a warning, so gate on backend)
-            _don9 = ((9,) if jax.default_backend() == "tpu" else ())
-            _compact_j = jax.jit(step_goss_compact_impl,
-                                 donate_argnums=_don9)
+            # of holding two copies across the step, plus the score
+            # carry when nothing re-reads it (tpu_donate)
+            _don_c = (((9,) if _donate else ())
+                      + ((5,) if _donate_score else ()))
+            _compact_j = _jit_don(step_goss_compact_impl, _don_c,
+                                  "the GOSS-compact step's donated "
+                                  "score")
 
             def _step_goss_compact(score, allowed, cegb_pen, key):
                 return _compact_j(dd.bins, dd.bins_t, dd.label,
@@ -1588,9 +1623,14 @@ class GBDT:
         # below therefore takes the big arrays as ARGUMENTS; thin Python
         # wrappers supply them per call (no transfer cost — they are
         # device-resident).
-        _valid_update_j = jax.jit(
+        # valid scores are a pure carry on the engines that donate
+        # (every reader sees only the reassigned list): donate them so
+        # each per-iteration valid update aliases in place too
+        _valid_update_j = _jit_don(
             lambda vbins, valid_scores, stacked_trees: valid_update_impl(
-                list(zip(vbins, valid_scores)), stacked_trees))
+                list(zip(vbins, valid_scores)), stacked_trees),
+            (1,) if _donate_valid else (),
+            "the valid-update's donated scores")
 
         def plain_valid_update(valid_scores, stacked_trees):
             vbins = tuple(self.valid_data[i].bins
@@ -1600,13 +1640,21 @@ class GBDT:
 
         if mesh is None:
             d = self.data
-            _tpu = jax.default_backend() == "tpu"
-            _step_j = jax.jit(step_impl,
-                              donate_argnums=(10,) if _tpu else ())
-            _goss_j = jax.jit(step_goss_impl,
-                              donate_argnums=(9,) if _tpu else ())
-            _custom_j = jax.jit(step_custom_impl,
-                                donate_argnums=(10,) if _tpu else ())
+            _step_j = _jit_don(
+                step_impl,
+                (((10,) if _donate else ())
+                 + ((4,) if _donate_score else ())),
+                "the step's donated score")
+            _goss_j = _jit_don(
+                step_goss_impl,
+                (((9,) if _donate else ())
+                 + ((4,) if _donate_score else ())),
+                "the GOSS step's donated score")
+            _custom_j = _jit_don(
+                step_custom_impl,
+                (((10,) if _donate else ())
+                 + ((2,) if _donate_score else ())),
+                "the custom-fobj step's donated score")
 
             def step(score, mask_gh, mask_count, allowed, cegb_pen, key):
                 return _step_j(d.bins, d.bins_t, d.label, d.weight, score,
@@ -1659,8 +1707,12 @@ class GBDT:
                         cegb_pen=cegb_pen)
                     return stacked, lids, ns, new_state
 
-                _state_j = jax.jit(_state_impl)
-                _goss_state_j = jax.jit(_goss_state_impl)
+                _don_st = (4,) if _donate_score else ()
+                _state_j = _jit_don(_state_impl, _don_st,
+                                    "the stateful step's donated score")
+                _goss_state_j = _jit_don(
+                    _goss_state_impl, _don_st,
+                    "the stateful GOSS step's donated score")
 
                 def step_state(score, mask_gh, mask_count, allowed,
                                cegb_pen, key, pos_state):
@@ -1743,9 +1795,17 @@ class GBDT:
                           row1, row1, rep, rep, rep),
                 out_specs=out_specs, check_vma=False)
 
-            _sh_step_j = jax.jit(sharded_step)
-            _sh_goss_j = jax.jit(sharded_goss)
-            _sh_custom_j = jax.jit(sharded_custom)
+            # the sharded score carry donates like the serial one: the
+            # mesh-sharded [n_pad, K] global array aliases shard-wise
+            _sh_step_j = _jit_don(
+                sharded_step, (4,) if _donate_score else (),
+                "the sharded step's donated score")
+            _sh_goss_j = _jit_don(
+                sharded_goss, (4,) if _donate_score else (),
+                "the sharded GOSS step's donated score")
+            _sh_custom_j = _jit_don(
+                sharded_custom, (2,) if _donate_score else (),
+                "the sharded custom-fobj step's donated score")
 
             def step(score, mask_gh, mask_count, allowed, cegb_pen, key):
                 return _sh_step_j(d.bins, d.bins_t, d.label, d.weight,
@@ -1768,8 +1828,7 @@ class GBDT:
                 # needs all columns); plain jit, no shard_map
                 valid_update = plain_valid_update
             else:
-                @jax.jit
-                def valid_update(valid_scores, stacked_trees):
+                def _sh_valid_impl(valid_scores, stacked_trees):
                     n_valid = len(valid_scores)
                     fn = shard_map(
                         lambda bins_scores, trees: tuple(valid_update_impl(
@@ -1784,6 +1843,10 @@ class GBDT:
                                   for i, s in enumerate(valid_scores))
                     return list(fn(pairs, stacked_trees))
 
+                valid_update = _jit_don(
+                    _sh_valid_impl, (0,) if _donate_valid else (),
+                    "the sharded valid-update's donated scores")
+
         @jax.jit
         def apply_renewed(score, leaf_ids, renewed_leaf_values):
             # re-apply renewed leaf outputs: score = score + lr * renewed
@@ -1795,8 +1858,9 @@ class GBDT:
         # ---- fused multi-iteration chunk (one dispatch per n iters) ----
         # Over a tunneled TPU each jit dispatch costs a latency round-trip
         # (~80ms); scanning the whole boosting step amortizes it. Only the
-        # pure-jit path qualifies (checked in train_chunk).
-        self._chunk_cache: Dict[Tuple[int, bool], Callable] = {}
+        # pure-jit path qualifies (checked in train_chunk). Keyed by the
+        # bare goss_now bool train_chunk looks up.
+        self._chunk_cache: Dict[bool, Callable] = {}
         F = self.num_features
 
         def make_chunk(goss: bool):
@@ -1823,8 +1887,14 @@ class GBDT:
                     return ns, stacked
                 return jax.lax.scan(body, score, keys)
 
+            # the chunk carry donates whenever the per-step score does
+            # (can_fuse_iters already excludes every host re-reader):
+            # without it the [n_pad, K] score rides an H2H copy through
+            # EVERY chunk even though the per-step jits alias theirs
             if mesh is None:
-                _chunk_j = jax.jit(chunk_impl)
+                _chunk_j = _jit_don(
+                    chunk_impl, (4,) if _donate_score else (),
+                    "the fused chunk's donated score")
 
                 def chunk(score, keys):
                     return _chunk_j(d_.bins, d_.bins_t, d_.label,
@@ -1838,11 +1908,14 @@ class GBDT:
                           rep),
                 out_specs=(row2, tree_specs), check_vma=False)
 
-            _sh_chunk_j = jax.jit(sharded_chunk)
+            _sh_chunk_j = _jit_don(
+                sharded_chunk, (4,) if _donate_score else (),
+                "the sharded fused chunk's donated score")
 
             def chunk(score, keys):
                 return _sh_chunk_j(d_.bins, d_.bins_t, d_.label,
-                                   d_.weight, score, d_.valid_mask, keys)
+                                   d_.weight, score, d_.valid_mask,
+                                   keys)
             return chunk
 
         self._make_chunk = make_chunk

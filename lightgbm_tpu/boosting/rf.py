@@ -29,6 +29,13 @@ from .gbdt import GBDT
 class RandomForest(GBDT):
     """RF engine (reference: src/boosting/rf.hpp RF : public GBDT)."""
 
+    # no carry donation (tpu_donate): every iteration re-feeds the
+    # persistent _score0 base tile into the step and reads it back to
+    # isolate the new tree's raw output — donation would delete the
+    # shared base buffer on the first dispatch (docs/perf.md
+    # "Iteration floor")
+    _donate_carries = False
+
     def __init__(self, config, train_set, fobj=None, mesh=None,
                  init_forest=None):
         # eligibility from the capability table's "rf" column (the

@@ -347,6 +347,13 @@ class StreamingGBDT:
         self.comm_stats = {"allreduce_calls": 0, "allreduce_bytes": 0,
                            "blocks_scanned": 0, "levels": 0}
 
+        # buffer donation for the streamed score slots (tpu_donate;
+        # docs/perf.md "Iteration floor"): each block's [block_rows]
+        # f32 score is a pure carry — the final sweep's output fully
+        # replaces the slot and every reader (eval_set, checkpoints,
+        # the stats prepass) sees only the reassigned reference
+        from ..utils.debug import donation_enabled
+        self._donate = donation_enabled(config)
         self._hist_rows_per_block = min(self.block_rows, 1 << 14)
         self._sweep = self._make_sweep()
         self._final = self._make_final()
@@ -644,7 +651,6 @@ class StreamingGBDT:
         track = self._track_stats
         core = self._stats_core() if track else None
 
-        @jax.jit
         def final(bins_blk, score_blk, label_blk, weight_blk, n_valid,
                   leaf_blk, tbl, leaf_out):
             leaf_new = _apply_table(bins_blk, leaf_blk, tbl)
@@ -659,7 +665,17 @@ class StreamingGBDT:
                 counts = jnp.zeros(1, jnp.int32)
             return leaf_new, score_new, maxs, counts
 
-        return final
+        # donate ONLY the score slot (argnum 1): the leaf slot cannot
+        # donate — at round start every block's slot points at the
+        # SHARED per-rank zeros block, and donating it on block 0's
+        # dispatch would delete the buffer blocks 1..n still pass
+        fn = jax.jit(final,
+                     donate_argnums=(1,) if self._donate else ())
+        if self._donate and self.config.tpu_debug_checks:
+            from ..utils.debug import donation_guard
+            fn = donation_guard(fn, "the streamed final sweep's "
+                                    "donated score slot")
+        return fn
 
     def _pack13(self, r, p):
         return jnp.concatenate([

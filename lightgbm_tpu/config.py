@@ -280,6 +280,24 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     "tpu_model_watch_interval": _P("float", 2.0, [], (0.0, None)),
     # ---- TPU-specific (new; no reference analog) -------------------------
     "tpu_rows_per_block": _P("int", 4096),
+    # buffer donation for the boosting carries (docs/perf.md "Iteration
+    # floor"): the per-step / fused-chunk / valid-update / streamed
+    # score jits donate their loop-state inputs
+    # (jax.jit(donate_argnums=...)) so XLA updates the carry in place
+    # instead of copying it through every dispatch. "auto" donates on
+    # the TPU backend only (the measured waste lives there; CPU test
+    # runs keep today's copy semantics), "true" forces donation on any
+    # backend that supports it (the CPU bit-identity tests), "false"
+    # disables it everywhere (the bench.py --no-donate A/B). Donated
+    # buffers are DELETED at dispatch — a stale Python reference read
+    # after the call is a bug; tpu_debug_checks names the donating
+    # site, and the donation-discipline linter (tools/analyze) flags
+    # the static shape of that mistake. Known-bad combo, refused with
+    # a warning: "true" on a non-TPU backend while a persistent
+    # compilation cache is configured — this jaxlib's CPU client
+    # corrupts the heap executing donating executables reloaded from
+    # the cache (docs/perf.md "Iteration floor").
+    "tpu_donate": _P("str", "auto"),
     "tpu_mesh_shape": _P("str", ""),
     "tpu_double_precision_hist": _P("bool", False),
     # rows per streamed chunk for two_round out-of-core file loading.
@@ -718,6 +736,7 @@ class Config:
                       f"(expected 'pool' or 'rebuild')")
         self.tpu_streaming = coerce_tristate(self.tpu_streaming,
                                              "tpu_streaming")
+        self.tpu_donate = coerce_tristate(self.tpu_donate, "tpu_donate")
         self.tpu_ingest_device = coerce_tristate(self.tpu_ingest_device,
                                                  "tpu_ingest_device")
         self.tpu_hist_partition = coerce_tristate(self.tpu_hist_partition,

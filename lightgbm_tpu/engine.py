@@ -255,6 +255,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         _prof_stack.callback(_finish_train_obs, cfg)
         if cfg.tpu_profile_dir:
             import jax
+            # registered BEFORE stop_trace (LIFO: stop runs first) so
+            # the attribution reads the freshly written dump and its
+            # train.copy_share / train.wall_busy_gap_ms gauges land in
+            # the snapshot _finish_train_obs flushes afterwards
+            _it0 = booster.current_iteration()
+            _prof_stack.callback(
+                lambda: _attr_profile_obs(cfg, booster, _it0))
             jax.profiler.start_trace(cfg.tpu_profile_dir)
             _prof_stack.callback(jax.profiler.stop_trace)
         # fused fast path: with no per-iteration host work (callbacks, eval,
@@ -323,6 +330,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
             booster.best_iteration = booster.current_iteration()
         obs.retire_heartbeat("train")
         return booster
+
+
+def _attr_profile_obs(cfg: Config, booster: "Booster",
+                      start_iter: int) -> None:
+    """Attribute the just-stopped ``tpu_profile_dir`` trace into the
+    ``train.copy_share`` / ``train.wall_busy_gap_ms`` gauges
+    (obs/trace_attr.py). Telemetry only — never fails the run."""
+    from .obs.trace_attr import profile_gauges
+    iters = max(booster.current_iteration() - start_iter, 0)
+    profile_gauges(cfg.tpu_profile_dir, iters=iters or None)
 
 
 def _finish_train_obs(cfg: Config) -> None:

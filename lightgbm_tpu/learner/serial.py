@@ -215,6 +215,17 @@ class GrowState(NamedTuple):
     the round-5 trace attributed ~9% of device busy to while-loop
     ``%copy`` traffic whose cost is per-ARRAY overhead, so fewer carry
     tuple elements means fewer copies per round at identical numerics.
+
+    Donation note (round 7, ``tpu_donate`` — docs/perf.md "Iteration
+    floor"): this carry — including the leaf-ordered partition arrays
+    (``part_bins``/``part_vals``, the largest elements) — lives
+    entirely INSIDE grow_tree's jit, and ``lax.while_loop`` exposes no
+    donation control; XLA's buffer assignment already aliases the
+    carry slots where liveness permits. The jit-boundary carries the
+    donation pass CAN reach (the step/chunk score, valid scores, the
+    streamed score slots, cegb_U) donate in boosting/gbdt.py and
+    boosting/streaming.py; the residual in-loop ``%copy`` is attacked
+    structurally (fewer arrays, above), not by donation.
     """
 
     split_idx: jnp.ndarray

@@ -1,0 +1,301 @@
+"""Trace-level attribution: raw XSpace (``*.xplane.pb``) op aggregation.
+
+docs/perf.md's "Trace-level attribution" table (the r5 measurement
+that pins ~67% of device busy on the histogram scan, ~9% on loop-state
+``%copy`` and a ~10 ms/iter wall-vs-busy gap) was built from a ~20-line
+ad-hoc parse of ``jax.profiler``'s xplane dump — the TensorBoard
+converter is protobuf-incompatible in this environment. This module
+promotes that parse into the obs plane proper:
+
+- a dependency-free protobuf **wire-format** reader (stdlib only — the
+  obs package's import-light constraint; no ``protobuf``, no jax) for
+  the XSpace schema subset the attribution needs: ``XSpace.planes``,
+  ``XPlane.name/lines/event_metadata``, ``XLine.name/timestamp_ns/
+  events``, ``XEvent.metadata_id/offset_ps/duration_ps/
+  num_occurrences``, ``XEventMetadata.id/name``;
+- per-op busy aggregation over the device plane's "XLA Ops" line,
+  the ``%copy`` share (the loop-state-copy signal the donation pass
+  exists to squeeze), and the per-iteration wall-vs-busy gap;
+- :func:`profile_gauges` feeds the result into the metrics registry as
+  ``train.copy_share`` / ``train.wall_busy_gap_ms`` — the same obs
+  plane scripts/check.sh snapshots and scripts/obs_trend.py guards, so
+  a ``%copy`` regression fails CI like an iters/sec regression does.
+
+Consumed by ``engine.train`` (after a ``tpu_profile_dir`` trace stops),
+``bench.py --profile-dir``, and the ``scripts/trace_attr.py`` CLI.
+CPU-backend traces carry no device op line (host threads only); every
+entry point degrades to "no device plane found" instead of failing the
+run that produced the trace.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["parse_xspace", "aggregate_ops", "attribute",
+           "newest_xplane", "profile_gauges"]
+
+# ops counted as loop-state / buffer copies in the share metric: HLO
+# names like "copy.1234", "%copy", "copy-start.5"/"copy-done.5" (async
+# copy pairs) — matched on the base name before the ".N" suffix
+_COPY_BASES = ("copy", "copy-start", "copy-done")
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire format (the ~20 lines, hardened)
+# ---------------------------------------------------------------------------
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    x = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        x |= (b & 0x7F) << s
+        if not b & 0x80:
+            return x, i
+        s += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    """(field_number, wire_type, value) triples of one message."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fnum, wt = tag >> 3, tag & 7
+        if wt == 0:                       # varint
+            v, i = _varint(buf, i)
+        elif wt == 2:                     # length-delimited
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:                     # 32-bit
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:                     # 64-bit
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt} at byte {i}")
+        yield fnum, wt, v
+
+
+def _parse_event(buf: bytes) -> Tuple[int, int, int, int]:
+    """XEvent -> (metadata_id, offset_ps, duration_ps, occurrences)."""
+    mid = off = dur = 0
+    occ = 1
+    for fnum, _wt, v in _fields(buf):
+        if fnum == 1:
+            mid = v
+        elif fnum == 2:
+            off = v
+        elif fnum == 3:
+            dur = v
+        elif fnum == 5:
+            occ = v
+    return mid, off, dur, occ
+
+
+def _parse_line(buf: bytes) -> Dict[str, Any]:
+    """XLine -> {name, timestamp_ns, events}."""
+    out: Dict[str, Any] = {"name": "", "timestamp_ns": 0, "events": []}
+    for fnum, _wt, v in _fields(buf):
+        if fnum == 2:
+            out["name"] = v.decode("utf-8", "replace")
+        elif fnum == 11 and not out["name"]:
+            out["name"] = v.decode("utf-8", "replace")
+        elif fnum == 3:
+            out["timestamp_ns"] = v
+        elif fnum == 4:
+            out["events"].append(_parse_event(v))
+    return out
+
+
+def _parse_plane(buf: bytes) -> Dict[str, Any]:
+    """XPlane -> {name, lines, event_names (metadata_id -> op name)}."""
+    out: Dict[str, Any] = {"name": "", "lines": [], "event_names": {}}
+    for fnum, _wt, v in _fields(buf):
+        if fnum == 2:
+            out["name"] = v.decode("utf-8", "replace")
+        elif fnum == 3:
+            out["lines"].append(_parse_line(v))
+        elif fnum == 4:
+            # map<int64, XEventMetadata> entry: key=1, value=2
+            key, name, disp = 0, "", ""
+            for f2, _w2, v2 in _fields(v):
+                if f2 == 1:
+                    key = v2
+                elif f2 == 2:
+                    for f3, _w3, v3 in _fields(v2):
+                        if f3 == 1:
+                            key = key or v3
+                        elif f3 == 2:
+                            name = v3.decode("utf-8", "replace")
+                        elif f3 == 4:
+                            disp = v3.decode("utf-8", "replace")
+            out["event_names"][key] = name or disp
+    return out
+
+
+def parse_xspace(data: bytes) -> List[Dict[str, Any]]:
+    """XSpace bytes -> list of plane dicts (schema subset above)."""
+    return [_parse_plane(v) for fnum, _wt, v in _fields(data)
+            if fnum == 1]
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+def _is_device_plane(name: str) -> bool:
+    return "/device:" in name
+
+
+def _base_op(name: str) -> str:
+    """HLO op base name: "%copy.123" -> "copy", "fusion.7" -> "fusion"."""
+    base = name.lstrip("%")
+    head = base.split(".", 1)[0]
+    return head
+
+
+def aggregate_ops(planes: List[Dict[str, Any]]
+                  ) -> Optional[Dict[str, Any]]:
+    """Per-op busy totals over the device plane's op line.
+
+    Picks the device plane (name contains "/device:") with the most op
+    events; within it the "XLA Ops" line when present, else every
+    line. Returns None when no device plane carries events — the CPU
+    backend's trace has host threads only.
+    """
+    best: Optional[Tuple[int, Dict[str, Any], List[Dict[str, Any]]]] = None
+    for plane in planes:
+        if not _is_device_plane(plane["name"]):
+            continue
+        lines = [ln for ln in plane["lines"] if ln["name"] == "XLA Ops"]
+        if not lines:
+            lines = [ln for ln in plane["lines"] if ln["events"]]
+        n_ev = sum(len(ln["events"]) for ln in lines)
+        if n_ev and (best is None or n_ev > best[0]):
+            best = (n_ev, plane, lines)
+    if best is None:
+        return None
+    _n, plane, lines = best
+    ops: Dict[str, List[float]] = {}
+    t0 = None
+    t1 = None
+    for ln in lines:
+        base_ps = ln["timestamp_ns"] * 1000
+        for mid, off, dur, occ in ln["events"]:
+            name = plane["event_names"].get(mid, f"op#{mid}")
+            ent = ops.setdefault(name, [0.0, 0])
+            ent[0] += dur * max(occ, 1)
+            ent[1] += max(occ, 1)
+            start = base_ps + off
+            end = start + dur
+            t0 = start if t0 is None else min(t0, start)
+            t1 = end if t1 is None else max(t1, end)
+    busy_ps = sum(v[0] for v in ops.values())
+    copy_ps = sum(v[0] for name, v in ops.items()
+                  if _base_op(name) in _COPY_BASES)
+    return {
+        "device_plane": plane["name"],
+        "ops": ops,                              # name -> [ps, calls]
+        "busy_ps": busy_ps,
+        "copy_ps": copy_ps,
+        "window_ps": (t1 - t0) if t0 is not None else 0,
+    }
+
+
+def newest_xplane(path: str) -> Optional[str]:
+    """``path`` itself if it is a file, else the newest ``*.xplane.pb``
+    under it (jax.profiler writes <dir>/plugins/profile/<ts>/...)."""
+    if os.path.isfile(path):
+        return path
+    newest, newest_m = None, -1.0
+    for dirpath, _dirs, files in os.walk(path):
+        for fn in files:
+            if fn.endswith(".xplane.pb"):
+                full = os.path.join(dirpath, fn)
+                m = os.path.getmtime(full)
+                if m > newest_m:
+                    newest, newest_m = full, m
+    return newest
+
+
+def attribute(path: str, iters: Optional[int] = None,
+              wall_ms: Optional[float] = None) -> Dict[str, Any]:
+    """Full attribution of one profile dump.
+
+    Args:
+      path: an ``.xplane.pb`` file or a ``tpu_profile_dir`` tree (the
+        newest dump inside is used).
+      iters: boosting iterations the traced window covered — enables
+        the per-iteration wall-vs-busy gap.
+      wall_ms: host-measured wall time of the traced window; defaults
+        to the device op line's first-start..last-end span.
+
+    Returns a dict with ``found`` False (and ``reason``) when there is
+    nothing to attribute; else ``ops`` (sorted descending by time,
+    each ``{name, ms, calls, share}``), ``busy_ms``, ``wall_ms``,
+    ``copy_ms``, ``copy_share`` and — with ``iters`` —
+    ``wall_busy_gap_ms`` per iteration.
+    """
+    f = newest_xplane(path)
+    if f is None:
+        return {"found": False, "reason": f"no .xplane.pb under {path}"}
+    try:
+        planes = parse_xspace(open(f, "rb").read())
+    except (OSError, ValueError, IndexError) as e:
+        return {"found": False,
+                "reason": f"cannot parse {f}: {type(e).__name__}: {e}"}
+    agg = aggregate_ops(planes)
+    if agg is None:
+        return {"found": False, "source": f,
+                "reason": "no device plane with op events (CPU/host "
+                          "trace?)"}
+    busy_ms = agg["busy_ps"] / 1e9
+    wall = wall_ms if wall_ms is not None else agg["window_ps"] / 1e9
+    out: Dict[str, Any] = {
+        "found": True,
+        "source": f,
+        "device_plane": agg["device_plane"],
+        "busy_ms": busy_ms,
+        "wall_ms": wall,
+        "copy_ms": agg["copy_ps"] / 1e9,
+        "copy_share": (agg["copy_ps"] / agg["busy_ps"]
+                       if agg["busy_ps"] else 0.0),
+        "ops": [
+            {"name": name, "ms": ps / 1e9, "calls": calls,
+             "share": (ps / agg["busy_ps"] if agg["busy_ps"] else 0.0)}
+            for name, (ps, calls) in sorted(
+                agg["ops"].items(), key=lambda kv: -kv[1][0])],
+    }
+    if iters:
+        out["iters"] = int(iters)
+        out["wall_busy_gap_ms"] = max(wall - busy_ms, 0.0) / int(iters)
+    return out
+
+
+def profile_gauges(profile_dir: str, iters: Optional[int] = None,
+                   wall_ms: Optional[float] = None) -> Dict[str, Any]:
+    """Attribute a finished ``tpu_profile_dir`` dump into the metrics
+    registry: ``train.copy_share`` (fraction of device busy spent in
+    copy ops) and — when ``iters`` is known — ``train.wall_busy_gap_ms``
+    (per-iteration wall-vs-busy gap). Forced gauges: asking for a
+    profiler trace IS opting into its attribution, tpu_metrics or not.
+    Never raises — a malformed dump warns and returns the reason; the
+    training/bench run that produced it must not fail on telemetry."""
+    from ..utils import log
+    try:
+        res = attribute(profile_dir, iters=iters, wall_ms=wall_ms)
+    except Exception as e:   # defense in depth: attribution is telemetry
+        res = {"found": False,
+               "reason": f"{type(e).__name__}: {e}"}
+    if not res.get("found"):
+        log.debug(f"trace_attr: nothing to attribute under "
+                  f"{profile_dir!r}: {res.get('reason')}")
+        return res
+    from . import set_gauge
+    set_gauge("train.copy_share", float(res["copy_share"]), force=True)
+    if "wall_busy_gap_ms" in res:
+        set_gauge("train.wall_busy_gap_ms",
+                  float(res["wall_busy_gap_ms"]), force=True)
+    return res

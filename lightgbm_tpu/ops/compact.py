@@ -205,6 +205,13 @@ def compact_rows(bins_t: jax.Array, vals_t: jax.Array, dest: jax.Array,
     if C_pad > C:
         vals_t = jnp.concatenate(
             [vals_t, jnp.zeros((C_pad - C, n), vals_t.dtype)])
+    # NO input_output_aliases on the output windows (examined, round 7
+    # — docs/perf.md "Iteration floor"): out_cols != n by construction
+    # (compaction_out_cols adds one block of write slack + lane
+    # padding), so neither [F_pad, out_cols] output can alias its
+    # [F_pad, n] input; and even at equal widths the kernel reads
+    # block b's source columns AFTER earlier blocks wrote their packed
+    # output left of them — in-place would clobber unread sources.
     out_b, out_v = pl.pallas_call(
         functools.partial(_compact_kernel, rows_per_block=R),
         grid_spec=pltpu.PrefetchScalarGridSpec(

@@ -123,6 +123,13 @@ def multi_leaf_histogram(bins_t: jax.Array, vals_t: jax.Array,
     kernel = functools.partial(_hist_kernel, num_bins=num_bins,
                                n_feat=F_blk, n_leaves=K, n_chan=C,
                                int_mode=int_mode)
+    # NO input_output_aliases here (examined, round 7 — docs/perf.md
+    # "Iteration floor"): the [B*F_pad, K*C] accumulator is an
+    # output-only carry across the sequential row-block grid, already
+    # accumulated in place in VMEM by the @pl.when(i>0) add; no input
+    # operand shares its shape/dtype, and threading a caller-supplied
+    # zeroed buffer just to alias it would ADD an HBM zero-fill per
+    # call — strictly worse than the status quo.
     out = pl.pallas_call(
         kernel,
         grid=(n_fb, n // R),

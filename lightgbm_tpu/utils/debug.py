@@ -84,6 +84,80 @@ class CompileWatch:
             pass
 
 
+def donation_enabled(config) -> bool:
+    """Resolve the ``tpu_donate`` tristate against the live backend.
+
+    Buffer donation (``jax.jit(donate_argnums=...)``) lets XLA update
+    the boosting carries in place instead of copying them through
+    every dispatch (docs/perf.md "Iteration floor"). "auto" donates on
+    the TPU backend only — the profiled ``%copy`` waste lives there
+    and CPU tier-1 runs keep today's copy semantics; "true" forces it
+    on any backend (this jaxlib's CPU client honors donation, which is
+    what makes the donation-on/off bit-identity tests real); "false"
+    disables it everywhere (the ``bench.py --no-donate`` A/B arm).
+
+    KNOWN-BAD COMBINATION, forced off with a warning: a non-TPU
+    backend with a persistent compilation cache configured. This
+    jaxlib's (0.4.37) CPU client intermittently corrupts the heap
+    executing a donating executable DESERIALIZED from the cache —
+    segfaults/aborts detonating later in unrelated native code.
+    Reproduced: donating train runs pass 100% against a cold cache and
+    crash most multi-train processes against a warm one; donation off
+    or cache off are each individually stable. TPU PJRT keeps both
+    (donation + persistent cache is the standard accelerator
+    combination upstream)."""
+    v = str(getattr(config, "tpu_donate", "auto"))
+    if v == "false":
+        return False
+    import jax
+    if jax.default_backend() == "tpu":
+        return True                           # auto and true alike
+    if v != "true":
+        return False
+    if getattr(jax.config, "jax_compilation_cache_dir", None):
+        from . import log
+        log.warning(
+            "tpu_donate=true ignored: this backend "
+            f"({jax.default_backend()}) intermittently crashes "
+            "executing donating executables reloaded from the "
+            "persistent compilation cache "
+            f"({jax.config.jax_compilation_cache_dir}); unset the "
+            "cache (jax_compilation_cache_dir) to force donation "
+            "off-TPU — docs/perf.md 'Iteration floor'")
+        return False
+    return True
+
+
+def donation_guard(fn, site: str):
+    """``tpu_debug_checks`` use-after-donate guard for a donating jit.
+
+    A donated buffer is DELETED when its dispatch is issued, so a
+    caller that re-reads a stale Python reference gets XLA's generic
+    ``RuntimeError: Array has been deleted`` wherever the read happens
+    to land — far from the donating call. This wrapper checks every
+    argument buffer BEFORE dispatch and fails with the donating site
+    named, turning the latent crash into an actionable error. Debug
+    path only (one ``is_deleted`` flag read per leaf); the production
+    wrappers call the jit directly."""
+    import jax
+
+    from . import log
+
+    def guarded(*args):
+        for leaf in jax.tree.leaves(args):
+            if getattr(leaf, "is_deleted", None) is not None \
+                    and leaf.is_deleted():
+                log.fatal(
+                    f"tpu_debug: use-after-donate at {site} — an "
+                    f"argument's buffer was already donated to an "
+                    f"earlier dispatch and deleted; re-reading a stale "
+                    f"reference is a bug (reassign before reading, or "
+                    f"set tpu_donate=false)")
+        return fn(*args)
+
+    return guarded
+
+
 def predict_program_cache_size() -> int:
     """Distinct compiled forest-traversal programs held by this process
     (the quantity batch-shape bucketing bounds)."""

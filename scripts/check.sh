@@ -125,6 +125,11 @@ print("obs " + json.dumps({
     # this number jumping back to the masked product
     "hist_rows_scanned": gauge("hist.rows_scanned"),
     "hist_partition": gauge("bench.hist_partition"),
+    # loop-state %copy share of device busy (trace attribution,
+    # scripts/trace_attr.py) — present when the bench ran with
+    # --profile-dir; obs_trend.py fails on it regressing above its
+    # trailing median like iters/sec
+    "copy_share": gauge("train.copy_share"),
     # streamed-training trajectory + the sharded-streaming dryrun pin
     "stream_rows_per_sec": gauge("bench.stream_rows_per_sec"),
     "stream_shards": gauge("bench.stream_shards"),

@@ -15,6 +15,11 @@ regressed past its threshold —
   plus ``--compile-slack`` absolute requests (cold-cache runs jitter
   by a couple);
 - ``peak_hbm_gib`` UP by more than ``--max-hbm-up``;
+- ``copy_share`` (fraction of device busy in loop-state ``%copy`` ops,
+  the signal the ``tpu_donate`` pass squeezes — docs/perf.md
+  "Iteration floor") UP by more than ``--max-copy-up`` (fraction)
+  plus ``--copy-slack`` absolute (the share sits near zero once
+  donation lands; a pure ratio would flag noise);
 - ``secs`` (suite wall clock) UP by more than ``--max-secs-up`` at a
   non-lower dot count (fewer dots = different suite, not a slowdown);
 - ``stream_dryrun`` == 0 in the NEWEST run (absolute, no baseline
@@ -48,6 +53,7 @@ Usage (scripts/check.sh runs it behind CHECK_TREND=1):
     python scripts/obs_trend.py [--log scripts/check_timings.log]
         [--window 5] [--max-ips-drop 0.15] [--max-compile-up 0.5]
         [--compile-slack 2] [--max-hbm-up 0.2] [--max-secs-up 0.35]
+        [--max-copy-up 0.5] [--copy-slack 0.005]
 Exit codes: 0 = no regression (or no history), 1 = regression, 2 = bad
 invocation (unreadable log path given explicitly).
 """
@@ -118,7 +124,8 @@ def _median_of(history: List[Dict[str, Any]],
 def check_trend(entries: List[Dict[str, Any]], window: int,
                 max_ips_drop: float, max_compile_up: float,
                 compile_slack: float, max_hbm_up: float,
-                max_secs_up: float) -> List[str]:
+                max_secs_up: float, max_copy_up: float = 0.5,
+                copy_slack: float = 0.005) -> List[str]:
     """Regression messages for the newest entry vs the trailing median
     of up to ``window`` earlier same-mode entries; [] = green."""
     if not entries:
@@ -192,6 +199,18 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
                 f"(trailing median {comp_med:g}; a compile-count jump "
                 f"is a warm-path recompile leak)")
 
+    cs_now = _num(newest, "copy_share")
+    cs_med = _median_of(history, "copy_share")
+    if cs_now is not None and cs_med is not None:
+        ceil = cs_med * (1.0 + max_copy_up) + copy_slack
+        if cs_now > ceil:
+            failures.append(
+                f"copy_share regressed: {cs_now:.4f} > {ceil:.4f} "
+                f"(trailing median {cs_med:.4f} over {len(history)} "
+                f"run(s)): loop-state %copy crept back — a donation "
+                f"gate dropped a carry (docs/perf.md 'Iteration "
+                f"floor')")
+
     hbm_now = _num(newest, "peak_hbm_gib")
     hbm_med = _median_of(history, "peak_hbm_gib")
     if hbm_now is not None and hbm_med:
@@ -229,6 +248,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--compile-slack", type=float, default=2.0)
     ap.add_argument("--max-hbm-up", type=float, default=0.2)
     ap.add_argument("--max-secs-up", type=float, default=0.35)
+    ap.add_argument("--max-copy-up", type=float, default=0.5)
+    ap.add_argument("--copy-slack", type=float, default=0.005,
+                    help="absolute copy_share headroom on top of the "
+                         "ratio (the share sits near zero once "
+                         "donation lands)")
     args = ap.parse_args(argv)
 
     try:
@@ -251,7 +275,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # (the stream_dryrun pin) still apply to it
     failures = check_trend(entries, args.window, args.max_ips_drop,
                            args.max_compile_up, args.compile_slack,
-                           args.max_hbm_up, args.max_secs_up)
+                           args.max_hbm_up, args.max_secs_up,
+                           args.max_copy_up, args.copy_slack)
     if failures:
         for msg in failures:
             print(f"obs_trend: REGRESSION — {msg}")

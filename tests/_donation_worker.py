@@ -1,0 +1,158 @@
+"""Subprocess worker for tests/test_donation.py: every donate-TRUE arm.
+
+Why a subprocess: ``tpu_donate=true`` on this jaxlib's (0.4.37) CPU
+client is only stable in a process that has NEVER mixed donation with
+a persistent compilation cache — warm-cache donating runs, and even
+long pytest processes that toggled the cache config around donating
+dispatches, intermittently corrupt the native heap (segfaults/aborts
+detonating later in unrelated code: numpy binning, jit tracing,
+``Config.__init__``). Cold, cache-less, donation-only processes pass
+100% (reproduced at length — docs/perf.md "Iteration floor"). So the
+donate-true half of every A/B runs HERE, in a fresh interpreter with
+the cache env stripped by the spawner, and ships its artifacts
+(model texts, raw predictions, eval trajectories, compile counts, the
+use-after-donate guard observation) back through one pickle; the
+pytest process trains only the cache-safe donate-false arms and
+compares. A worker crash fails the donation tests loudly without
+taking the other ~600 tests down with it.
+
+Shared definitions (data synthesis, the mode x variant matrix, params)
+live in this module and are imported BY the test module — one source,
+no drift; this file's import side effects are numpy-only.
+"""
+import os
+import pickle
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_ROUNDS = 8
+VALID_ROUNDS = 12
+
+# learning_rate 0.5 -> GOSS activates at iteration 2 of 8, so the GOSS
+# variants exercise BOTH the plain and the sampled step under donation
+VARIANTS = {
+    "plain": {},
+    "goss": {"data_sample_strategy": "goss", "learning_rate": 0.5,
+             "top_rate": 0.3, "other_rate": 0.3},
+    "quantized": {"use_quantized_grad": True},
+}
+
+MODES = {
+    "per_iter": {"tpu_fuse_iters": 1},
+    "fused_chunk": {"tpu_fuse_iters": 4},
+    "sharded": {"tree_learner": "data"},
+    "streamed": {"tpu_streaming": "true",
+                 "tpu_stream_block_rows": 1024},
+}
+
+
+def make_data(n=2048, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = ((X @ w + 0.6 * X[:, 0] * X[:, 1]
+          + rng.normal(scale=0.5, size=n)) > 0).astype(np.float64)
+    return X, y
+
+
+def params_for(extra, donate):
+    return {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "tpu_donate": donate, **extra}
+
+
+def main(out_path: str) -> int:
+    # the spawner strips JAX_COMPILATION_CACHE_DIR and forces the
+    # 8-fake-device CPU platform (same mesh shape as tests/conftest.py,
+    # so sharded-mode numerics match the in-process donate-false arm)
+    assert not os.environ.get("JAX_COMPILATION_CACHE_DIR"), \
+        "worker must run WITHOUT a persistent compilation cache"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() == 8, jax.devices()
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.debug import CompileWatch, donation_enabled
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    out = {}
+
+    # donation really is LIVE in this process: enabled by the config
+    # resolution AND the client deletes a donated input at dispatch
+    out["donation_enabled_true"] = donation_enabled(
+        Config({"objective": "binary", "tpu_donate": "true",
+                "verbosity": -1}))
+    import jax.numpy as jnp
+    probe = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+    a = jnp.ones((8, 8))
+    probe(a)
+    out["probe_input_deleted"] = bool(a.is_deleted())
+
+    # the bit-identity matrix, donate-true halves
+    X, y = make_data()
+    combos = {}
+    for mode, mextra in MODES.items():
+        for variant, vextra in VARIANTS.items():
+            p = params_for({**mextra, **vextra}, "true")
+            m = lgb.train(p, lgb.Dataset(X, label=y),
+                          num_boost_round=N_ROUNDS)
+            combos[f"{mode}-{variant}"] = {
+                "model": m.model_to_string(),
+                "pred": np.asarray(m.predict(X, raw_score=True)),
+            }
+    out["combos"] = combos
+
+    # valid-score donation: eval trajectory + early-stop decision
+    Xt, yt = make_data(seed=3)
+    Xv, yv = make_data(n=1024, seed=4)
+    rec = {}
+    ds = lgb.Dataset(Xt, label=yt)
+    bst = lgb.train(
+        params_for({"metric": "binary_logloss"}, "true"), ds,
+        num_boost_round=VALID_ROUNDS,
+        valid_sets=[lgb.Dataset(Xv, label=yv, reference=ds)],
+        valid_names=["v"],
+        callbacks=[lgb.record_evaluation(rec),
+                   lgb.early_stopping(5, verbose=False)])
+    out["valid"] = {"record": rec, "best_iteration": bst.best_iteration}
+
+    # compile accounting for the zero-added-programs pin
+    X5, y5 = make_data(seed=5)
+    eng = GBDT(Config(params_for({"tpu_fuse_iters": 4}, "true")),
+               lgb.Dataset(X5, label=y5))
+    with CompileWatch("cold donated") as w_cold:
+        eng.train_chunk(8)
+    with CompileWatch("warm donated") as w_warm:
+        eng.train_chunk(8)
+    out["compile_true_cold"] = w_cold.compiles
+    out["compile_true_warm"] = w_warm.compiles
+
+    # use-after-donate guard: the stale-score read must raise the
+    # guard's error, not XLA's generic deleted-array RuntimeError
+    X6, y6 = make_data(seed=6)
+    eng = GBDT(Config(params_for({"tpu_debug_checks": True}, "true")),
+               lgb.Dataset(X6, label=y6))
+    stale = eng.score
+    eng.train_one_iter()
+    out["stale_deleted"] = bool(stale.is_deleted())
+    eng.score = stale
+    try:
+        eng.train_one_iter()
+        out["guard_fired"] = False
+        out["guard_message"] = ""
+    except LightGBMError as e:
+        out["guard_fired"] = True
+        out["guard_message"] = str(e)
+
+    with open(out_path, "wb") as f:
+        pickle.dump(out, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

@@ -2,11 +2,13 @@
 
 Three layers (ISSUE 10 acceptance):
 
-1. per-checker FIXTURES — for each of the five drift linters, a
+1. per-checker FIXTURES — for each of the six drift linters, a
    snippet that MUST flag and a snippet that MUST pass, including the
    three historical drift-bug classes: a gate literal outside the
    capability table, a raw ``tpu_*`` param read, and a
-   ``lax.switch``-wrapped collective (the PR 12 deadlock class);
+   ``lax.switch``-wrapped collective (the PR 12 deadlock class), plus
+   the use-after-donate class the ``tpu_donate`` pass introduces
+   (donation-discipline);
 2. allowlist hygiene — unexplained and stale entries are findings;
 3. the extended drift-guard sweep — for EVERY engine, the capability
    table's verdicts agree with what the constructor actually does
@@ -290,6 +292,96 @@ def test_lock_discipline_scope_is_obs_only():
     assert run_checker_on_source("lock-discipline", src,
                                  rel="lightgbm_tpu/engine_fixture.py") \
         == []
+
+
+# ---------------------------------------------------------------------------
+# checker 6: donation-discipline — donated references rebind before reads
+# ---------------------------------------------------------------------------
+def test_donation_discipline_flags_read_after_donate():
+    # the use-after-donate class the tpu_donate pass introduces: the
+    # jit deletes its donated argument buffer at dispatch, so the
+    # later `score.sum()` reads a deleted array
+    src = (
+        "import jax\n"
+        "_j = jax.jit(lambda s: s + 1, donate_argnums=(0,))\n"
+        "def train(score):\n"
+        "    out = _j(score)\n"
+        "    return out + score.sum()\n")
+    ks = _keys(run_checker_on_source("donation-discipline", src))
+    assert ks == {"train._j:score"}
+
+
+def test_donation_discipline_flags_unrebound_loop_carry():
+    # a donating call in a loop whose carry is never reassigned in the
+    # body re-reads the deleted buffer on the NEXT iteration
+    src = (
+        "import jax\n"
+        "def train(score, keys):\n"
+        "    _j = jax.jit(lambda s, k: s + k, donate_argnums=(0,))\n"
+        "    for k in keys:\n"
+        "        out = _j(score, k)\n"
+        "    return out\n")
+    ks = _keys(run_checker_on_source("donation-discipline", src))
+    assert ks == {"train._j:score"}
+
+
+def test_donation_discipline_flags_read_after_branch_and_self_attr():
+    # reads in the continuation AFTER an `if` that donated, and the
+    # __init__-builds / step-calls split on self attributes (the
+    # class-scope pre-pass)
+    src_if = (
+        "import jax\n"
+        "def f(score, c):\n"
+        "    _j = jax.jit(lambda s: s + 1, donate_argnums=(0,))\n"
+        "    if c:\n"
+        "        out = _j(score)\n"
+        "    return score.sum()\n")
+    assert _keys(run_checker_on_source(
+        "donation-discipline", src_if)) == {"f._j:score"}
+    src_self = (
+        "import jax\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._j = jax.jit(lambda s: s + 1,\n"
+        "                          donate_argnums=(0,))\n"
+        "    def step(self):\n"
+        "        out = self._j(self.score)\n"
+        "        return out + self.score\n")
+    assert _keys(run_checker_on_source(
+        "donation-discipline", src_self)) == {"step.self._j:self.score"}
+
+
+def test_donation_discipline_passes_rebound_carries():
+    # the sanctioned shapes: `score = step(score)` loop carries,
+    # return-only wrapper call sites (boosting/gbdt.py's closures),
+    # conditional donate_argnums resolved through a local name, and
+    # jits that do not donate at all
+    src = (
+        "import jax\n"
+        "def train(score, keys):\n"
+        "    _j = jax.jit(lambda s, k: s + k, donate_argnums=(0,))\n"
+        "    for k in keys:\n"
+        "        score = _j(score, k)\n"
+        "    return score\n"
+        "def make(guard, flag):\n"
+        "    _don = (4,) if flag else ()\n"
+        "    _j2 = guard(jax.jit(lambda *a: a[4],\n"
+        "                        donate_argnums=_don), 'site')\n"
+        "    def step(score):\n"
+        "        return _j2(0, 1, 2, 3, score)\n"
+        "    return step\n"
+        "def plain(score):\n"
+        "    _nj = jax.jit(lambda s: s + 1)\n"
+        "    out = _nj(score)\n"
+        "    return out + score\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._j = jax.jit(lambda s: s + 1,\n"
+        "                          donate_argnums=(0,))\n"
+        "    def step(self):\n"
+        "        self.score = self._j(self.score)\n"
+        "        return self.score\n")
+    assert run_checker_on_source("donation-discipline", src) == []
 
 
 # ---------------------------------------------------------------------------

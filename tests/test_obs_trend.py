@@ -96,6 +96,32 @@ def test_compile_and_hbm_regressions_fail(tmp_path):
     assert rc == 0, out
 
 
+def test_copy_share_regression_fails(tmp_path):
+    """The donation sentinel (docs/perf.md "Iteration floor"): the
+    loop-state %copy share creeping back above its trailing median
+    (ratio + absolute slack) fails like an iters/sec drop; jitter
+    inside the slack and histories without the signal stay green."""
+    def _with_cs(cs):
+        e = json.loads(_obs_line()[len("obs "):])
+        e["copy_share"] = cs
+        return "obs " + json.dumps(e)
+
+    base = [_with_cs(0.02) for _ in range(4)]
+    # 0.02 * 1.5 + 0.005 = 0.035 ceiling: 0.09 (a dropped donation
+    # gate re-copying the carry) must fail
+    rc, out = _run(tmp_path, base + [_with_cs(0.09)])
+    assert rc == 1 and "copy_share regressed" in out
+    # within ratio+slack stays green
+    rc, out = _run(tmp_path, base + [_with_cs(0.03)])
+    assert rc == 0, out
+    # signal absent on either side -> skipped, like the other gauges
+    rc, out = _run(tmp_path, base + [_obs_line()])
+    assert rc == 0, out
+    rc, out = _run(tmp_path, [_obs_line() for _ in range(4)]
+                   + [_with_cs(0.09)])
+    assert rc == 0, out
+
+
 def test_wall_clock_regression_needs_same_or_more_dots(tmp_path):
     base = [_obs_line(secs=300, dots=38) for _ in range(4)]
     rc, out = _run(tmp_path, base + [_obs_line(secs=600, dots=38)])

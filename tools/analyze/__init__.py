@@ -1,4 +1,4 @@
-"""Repo-native static analysis: five drift linters + allowlists.
+"""Repo-native static analysis: six drift linters + allowlists.
 
 ``python -m tools.analyze`` — dependency-free (stdlib ``ast``), < 10 s,
 wired into scripts/check.sh (``lint_findings=`` on the obs line, exit
@@ -7,12 +7,14 @@ allowlist workflow and how-to-add-a-checker: docs/static-analysis.md.
 
 Checkers (each with ``tools/analyze/allowlists/<name>.txt``):
 
-- ``capability-gate``   — eligibility literals outside capabilities.py
-- ``config-knobs``      — raw/undeclared/undocumented ``tpu_*`` knobs
-- ``obs-names``         — code ⟂ docs/observability.md catalogue drift
-- ``collective-safety`` — collectives inside lax.switch/cond branches
-                          or rank-divergent conditionals (PR 12 class)
-- ``lock-discipline``   — obs shared state mutated outside the lock
+- ``capability-gate``      — eligibility literals outside capabilities.py
+- ``config-knobs``         — raw/undeclared/undocumented ``tpu_*`` knobs
+- ``obs-names``            — code ⟂ docs/observability.md catalogue drift
+- ``collective-safety``    — collectives inside lax.switch/cond branches
+                             or rank-divergent conditionals (PR 12 class)
+- ``lock-discipline``      — obs shared state mutated outside the lock
+- ``donation-discipline``  — a donated jit argument read again before
+                             reassignment (use-after-donate, PR 16 class)
 """
 from __future__ import annotations
 
@@ -23,7 +25,7 @@ import time
 from typing import Dict, List, Optional
 
 from . import (capability_gate, collective_safety, config_knobs,
-               lock_discipline, obs_names)
+               donation_discipline, lock_discipline, obs_names)
 from .core import Allowlist, Finding, SourceSet, discover_sources
 
 CHECKERS = {
@@ -32,6 +34,7 @@ CHECKERS = {
     obs_names.NAME: obs_names.check,
     collective_safety.NAME: collective_safety.check,
     lock_discipline.NAME: lock_discipline.check,
+    donation_discipline.NAME: donation_discipline.check,
 }
 
 REPO_ROOT = os.path.dirname(os.path.dirname(

@@ -1,0 +1,330 @@
+"""Checker 6: donation-discipline — a ``jax.jit(donate_argnums=...)``
+deletes its donated argument buffers at DISPATCH, so a call site that
+reads the same Python reference again before reassigning it holds a
+latent ``RuntimeError: Array has been deleted`` that detonates far from
+the donating call (docs/perf.md "Iteration floor"; the runtime twin is
+``utils/debug.py::donation_guard`` under ``tpu_debug_checks``).
+
+What is tracked, lexically (stdlib ``ast``, one pass per module):
+
+- *donors*: a local name (or ``self.<attr>``) bound to a call whose
+  subtree contains ``jit(..., donate_argnums=...)`` — wrapper calls
+  around the jit (the repo's ``_guard(jax.jit(...), "site")`` pattern)
+  are seen through. The donated POSITIONS are every int constant in
+  the ``donate_argnums`` expression, resolving one level of local
+  names (``_don = (4,) if x else (); jax.jit(f, donate_argnums=_don)``
+  donates {4}): a conditionally-donating jit must satisfy the
+  discipline of its donating arm.
+- *call sites* of a donor in the same scope (donor bindings are
+  visible to nested functions, like the closures in boosting/gbdt.py;
+  ``self.<attr>`` donors are hoisted to the class scope by a pre-pass,
+  so an ``__init__``-built jit called from a sibling method is checked
+  whatever the method order).
+  For each donated position whose argument is a bare name or a
+  ``self.<attr>``, a finding fires when that reference is READ again
+  before being reassigned:
+
+  1. in any later statement of the enclosing body (loads are checked
+     before stores within a statement, so ``x = g(x)`` after ``f(x)``
+     donated ``x`` is correctly a finding — the load feeds ``g``);
+  2. by the NEXT ITERATION of an enclosing loop: a donating call
+     inside a loop whose donated reference is never reassigned in that
+     loop body re-reads the deleted buffer when the loop comes around
+     (the carry must be rebound, ``score = step(score)``-style).
+
+Reassignment kills tracking (plain store, tuple-unpack, ``for`` target,
+``with ... as``); ``del`` also kills it (an explicit drop is the
+opposite of a stale read). Nested function definitions are their own
+scope — a closure that captures a donated name is a runtime-ordering
+question this lexical pass stays out of.
+
+Key: ``<scope>.<donor>:<ref>`` (scope = enclosing function name or
+"<module>" — stable across line edits).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, SourceSet
+
+NAME = "donation-discipline"
+
+# a reference we can track: ("", name) for a bare local,
+# ("self", attr) for self.<attr>
+Ref = Tuple[str, str]
+
+
+def _ref_of(node: ast.AST) -> Optional[Ref]:
+    if isinstance(node, ast.Name):
+        return ("", node.id)
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return ("self", node.attr)
+    return None
+
+
+def _fmt(ref: Ref) -> str:
+    return f"self.{ref[1]}" if ref[0] == "self" else ref[1]
+
+
+def _int_consts(node: ast.AST) -> Set[int]:
+    """Every int constant in an expression — the donated positions of
+    a donate_argnums value like ``((9,) if a else ()) + ((5,) if b
+    else ())`` resolve to {9, 5} (bools are not argnums)."""
+    out: Set[int] = set()
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Constant) and isinstance(n.value, int)
+                and not isinstance(n.value, bool)):
+            out.add(n.value)
+    return out
+
+
+def _donated_positions(rhs: ast.AST,
+                       local_exprs: Dict[str, ast.AST]) -> Set[int]:
+    """Donated argnums of the innermost ``jit(...)`` call in ``rhs``
+    (wrapper calls are seen through); {} when none donates."""
+    for n in ast.walk(rhs):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        tail = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if tail != "jit":
+            continue
+        for kw in n.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            val = kw.value
+            if isinstance(val, ast.Name) and val.id in local_exprs:
+                val = local_exprs[val.id]
+            return _int_consts(val)
+    return set()
+
+
+def _stores_in(node: ast.AST, ref: Ref) -> bool:
+    """Does this subtree (nested defs included — any rebind in the loop
+    body counts, wherever it lexically sits) store or ``del`` ref?"""
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            if (_ref_of(n) == ref
+                    and isinstance(n.ctx, (ast.Store, ast.Del))):
+                return True
+    return False
+
+
+def _first_read(node: ast.AST, ref: Ref) -> Optional[int]:
+    """Line of a Load of ref in this subtree, None if absent. Skips
+    nested function/lambda bodies (their execution time is unknown)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return None
+    if (isinstance(node, (ast.Name, ast.Attribute))
+            and _ref_of(node) == ref
+            and isinstance(node.ctx, ast.Load)):
+        return node.lineno
+    for child in ast.iter_child_nodes(node):
+        line = _first_read(child, ref)
+        if line is not None:
+            return line
+    return None
+
+
+def _read_before_store(stmts: List[ast.stmt],
+                       ref: Ref) -> Optional[int]:
+    """Scan statements in order: line of the first Load of ref before
+    any Store kills the tracking (loads within a statement are checked
+    first — RHS evaluates before the target binds)."""
+    for stmt in stmts:
+        line = _first_read(stmt, ref)
+        if line is not None:
+            return line
+        if _stores_in(stmt, ref):
+            return None
+    return None
+
+
+class _Scope:
+    """One lexical scope's donor table, visible to nested scopes."""
+
+    def __init__(self, name: str, parent: Optional["_Scope"] = None,
+                 is_class: bool = False):
+        self.name = name
+        self.parent = parent
+        self.is_class = is_class
+        self.donors: Dict[Ref, Set[int]] = {}
+        # last plain RHS per local name, for donate_argnums=NAME
+        self.exprs: Dict[str, ast.AST] = {}
+
+    def lookup(self, ref: Ref) -> Optional[Set[int]]:
+        s: Optional[_Scope] = self
+        while s is not None:
+            if ref in s.donors:
+                return s.donors[ref]
+            s = s.parent
+        return None
+
+    def flat_exprs(self) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        s: Optional[_Scope] = self
+        chain = []
+        while s is not None:
+            chain.append(s)
+            s = s.parent
+        for sc in reversed(chain):
+            out.update(sc.exprs)
+        return out
+
+
+# compound statements whose BODIES are scanned by their own recursion
+# step — only the header expressions belong to the statement itself
+_HEADERS = {ast.For: ("target", "iter"),
+            ast.AsyncFor: ("target", "iter"),
+            ast.While: ("test",), ast.If: ("test",),
+            ast.With: ("items",), ast.AsyncWith: ("items",),
+            ast.Try: ()}
+
+
+def _calls_in(stmt: ast.stmt) -> List[ast.Call]:
+    """Calls lexically in this statement, skipping nested defs and the
+    bodies of compound statements (those recurse separately with their
+    own continuation)."""
+    out: List[ast.Call] = []
+
+    def walk(node: ast.AST):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if type(node) in _HEADERS:
+            for fname in _HEADERS[type(node)]:
+                v = getattr(node, fname)
+                for x in (v if isinstance(v, list) else [v]):
+                    walk(x)
+            return
+        if isinstance(node, ast.Call):
+            out.append(node)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(stmt)
+    return out
+
+
+def _collect_class_donors(cls: ast.ClassDef, scope: _Scope) -> None:
+    """Pre-pass over a class body: ``self.<attr> = ...jit(donate...)``
+    in ANY method registers a class-scope donor, so a call site in a
+    sibling method (the ``__init__``-builds / ``step``-calls split) is
+    checked regardless of method order."""
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        exprs: Dict[str, ast.AST] = {}
+        for n in ast.walk(item):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1):
+                continue
+            ref = _ref_of(n.targets[0])
+            if ref is None:
+                continue
+            if ref[0] == "":
+                exprs[ref[1]] = n.value
+                continue
+            pos = _donated_positions(n.value, exprs)
+            if pos:
+                scope.donors[ref] = pos
+
+
+def _scan_body(rel: str, body: List[ast.stmt], scope: _Scope,
+               loop_bodies: List[List[ast.stmt]],
+               rest: List[ast.stmt], out: List[Finding]) -> None:
+    """``rest`` is the continuation: statements that run after this
+    body completes (reads there see the donated buffer too)."""
+    for i, stmt in enumerate(body):
+        later = body[i + 1:] + rest
+        # donor definitions: NAME = <expr containing jit(donate...)>
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            ref = _ref_of(stmt.targets[0])
+            if ref is not None:
+                pos = _donated_positions(stmt.value, scope.flat_exprs())
+                if pos:
+                    scope.donors[ref] = pos
+                if ref[0] == "":
+                    scope.exprs[ref[1]] = stmt.value
+        # donor call sites in this statement
+        for call in _calls_in(stmt):
+            cref = _ref_of(call.func)
+            if cref is None:
+                continue
+            donated = scope.lookup(cref)
+            if not donated:
+                continue
+            for p in sorted(donated):
+                if p >= len(call.args):
+                    continue
+                aref = _ref_of(call.args[p])
+                if aref is None:
+                    continue
+                if _stores_in(stmt, aref):
+                    # the call statement rebinds the reference (the
+                    # `score = step(score)` carry shape) — tracking
+                    # ends here
+                    continue
+                read = _read_before_store(later, aref)
+                where = "after the call"
+                if read is None:
+                    # enclosing-loop rule: un-rebound carry re-reads
+                    # the deleted buffer next iteration
+                    for lbody in loop_bodies:
+                        if not _stores_in(ast.Module(
+                                body=lbody, type_ignores=[]), aref):
+                            read = call.lineno
+                            where = ("on the next iteration of the "
+                                     "enclosing loop (the carry is "
+                                     "never reassigned in its body)")
+                            break
+                if read is not None:
+                    out.append(Finding(
+                        NAME, rel, read,
+                        f"{scope.name}.{_fmt(cref)}:{_fmt(aref)}",
+                        f"`{_fmt(aref)}` is donated to "
+                        f"`{_fmt(cref)}` (argument {p}) but read "
+                        f"again {where} — the buffer is deleted at "
+                        f"dispatch; reassign the reference before "
+                        f"any further read (docs/perf.md "
+                        f"'Iteration floor')"))
+        # recurse: nested scopes see this scope's donors
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_body(rel, stmt.body, _Scope(stmt.name, scope),
+                       [], [], out)
+        elif isinstance(stmt, ast.ClassDef):
+            cscope = _Scope(stmt.name, scope, is_class=True)
+            _collect_class_donors(stmt, cscope)
+            _scan_body(rel, stmt.body, cscope, [], [], out)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            _scan_body(rel, stmt.body, scope,
+                       loop_bodies + [stmt.body], later, out)
+            _scan_body(rel, stmt.orelse, scope, loop_bodies, later,
+                       out)
+        elif isinstance(stmt, ast.If):
+            _scan_body(rel, stmt.body, scope, loop_bodies, later, out)
+            _scan_body(rel, stmt.orelse, scope, loop_bodies, later,
+                       out)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            _scan_body(rel, stmt.body, scope, loop_bodies, later, out)
+        elif isinstance(stmt, ast.Try):
+            _scan_body(rel, stmt.body, scope, loop_bodies, later, out)
+            for h in stmt.handlers:
+                _scan_body(rel, h.body, scope, loop_bodies, later,
+                           out)
+            _scan_body(rel, stmt.orelse, scope, loop_bodies, later,
+                       out)
+            _scan_body(rel, stmt.finalbody, scope, loop_bodies, later,
+                       out)
+
+
+def check(sources: SourceSet) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, tree in sources.items():
+        _scan_body(rel, tree.body, _Scope("<module>"), [], [], out)
+    return out
