@@ -14,12 +14,22 @@ Run:
                                                         # 8 clients, 10 s
   python benchmarks/serve_bench.py --models 8 --clients 16 --seconds 30
   python benchmarks/serve_bench.py --cache-models 2     # force LRU churn
+  python benchmarks/serve_bench.py --trace-dir /tmp/t   # request-
+    lifecycle tracing ON (docs/observability.md "Request tracing"):
+    the final record adds the per-stage latency decomposition
+    (queue-wait / coalesce / checkout / dispatch / postprocess p50+p99
+    from the SLO windows, slo.device_share, flush-cause counts) and a
+    Chrome trace of the run is exported for Perfetto
   python benchmarks/serve_bench.py --smoke              # CI gate:
     sub-minute — concurrent clients, one LRU eviction, one mid-traffic
-    hot-swap; exit 0 iff zero requests dropped AND zero warm-path
-    compiles (scripts/check.sh appends the result as serve_smoke= on
-    the obs line; scripts/obs_trend.py fails ABSOLUTELY on
-    serve_smoke=0)
+    hot-swap, tracing flipped ON mid-traffic; exit 0 iff zero requests
+    dropped, zero warm-path compiles (tracing included), the traced/
+    untraced RPS overhead stays under 3%, and the traced per-stage
+    decomposition sums to the measured end-to-end p50 within 10%
+    (scripts/check.sh appends the result as serve_smoke= and the
+    windowed queue_wait_p99_ms= on the obs line; scripts/obs_trend.py
+    fails ABSOLUTELY on serve_smoke=0 and on queue-wait p99 regressing
+    past its trailing median)
 
 Each line is one JSON record; the final line aggregates.
 """
@@ -71,6 +81,87 @@ def _quantile(sorted_lat, q):
     return sorted_lat[i]
 
 
+# the per-batch stage spans the dispatch loop records, in lifecycle
+# order (docs/observability.md "Request tracing")
+STAGES = ("serve/queue_wait", "serve/coalesce",
+          "serve/registry_checkout", "serve/dispatch",
+          "serve/postprocess")
+
+
+def _ms(v):
+    return None if v is None else round(v * 1000.0, 3)
+
+
+def _window_decomposition(slo_mod):
+    """Per-stage p50/p99 (ms) from the live SLO sliding windows — the
+    same windows the ``slo.queue_wait_*``/``slo.dispatch_p99_ms``
+    gauges derive from (bucket-interpolated estimates)."""
+    t = slo_mod.tracker()
+    if t is None:
+        return {}
+    out = {}
+    for name in STAGES + ("serve/e2e",):
+        h = t.hists.get(name)
+        p50, p99 = (h.quantiles((0.50, 0.99)) if h is not None
+                    else (None, None))
+        key = name.split("/", 1)[1]
+        out[f"{key}_p50_ms"] = _ms(p50)
+        out[f"{key}_p99_ms"] = _ms(p99)
+    return out
+
+
+def _flush_causes(reg):
+    """Observed ``serve.flush_cause{cause=...}`` counter values."""
+    out = {}
+    for c in ("fill", "freeze", "deadline", "close"):
+        m = reg.get("serve.flush_cause", cause=c)
+        if m is not None:
+            out[c] = m.value
+    return out
+
+
+def _trace_decomposition(evs):
+    """EXACT per-stage p50s from raw trace events of a sequential
+    (1-rider-per-batch) window: per-request end-to-end is the gap
+    from the queue-wait event's start (enqueue) to its batch span's
+    end (resolve). Events group by scanning in buffer order — each
+    group CLOSES at its ``serve/batch`` event (the batch span exits
+    last) and must carry every stage exactly once with the queue
+    wait's request id matching the batch's, so a straggler event from
+    an earlier window (the dispatch thread records the batch AFTER
+    the caller's future resolves) yields one dropped partial group,
+    never an off-by-one pairing of every later request. Returns None
+    when the window caught no complete batch."""
+    groups, cur = [], {}
+    for e in evs:
+        name = e["name"]
+        if name in STAGES:
+            cur[name] = e
+        elif name == "serve/batch":
+            qw = cur.get("serve/queue_wait")
+            if (len(cur) == len(STAGES) and qw is not None
+                    and qw["args"].get("req") == e["args"].get("req")):
+                groups.append((cur, e))
+            cur = {}
+    if not groups:
+        return None
+
+    def p50(vals):
+        return _quantile(sorted(vals), 0.50)
+    e2e = [b["ts"] + b["dur"] - g["serve/queue_wait"]["ts"]
+           for g, b in groups]
+    sums = [sum(g[s]["dur"] for s in STAGES) for g, _b in groups]
+    out = {f"{s.split('/', 1)[1]}_p50_ms":
+           round(p50([g[s]["dur"] for g, _b in groups]) / 1e3, 3)
+           for s in STAGES}
+    out.update({
+        "requests": len(groups),
+        "e2e_p50_ms": round(p50(e2e) / 1e3, 3),
+        "stage_sum_p50_ms": round(p50(sums) / 1e3, 3),
+    })
+    return out
+
+
 # ---------------------------------------------------------------------------
 # full load run
 # ---------------------------------------------------------------------------
@@ -79,6 +170,10 @@ def run_load(args):
     from lightgbm_tpu.obs import slo as _slo
     from lightgbm_tpu.serve import PredictService
     obs.enable(metrics=True, slo=True)
+    if args.trace_dir:
+        # request-lifecycle tracing: per-batch span trees + rider
+        # flows, exported as a Chrome trace at the end of the run
+        obs.enable(metrics=False, trace_dir=args.trace_dir)
     X, y = _data(args.rows)
     svc = PredictService({
         "tpu_serve_batch_budget_ms": args.budget_ms,
@@ -142,6 +237,12 @@ def run_load(args):
         "dropped": len(drops),
         "queue_depth_max": depth_max,
         "slo_queue_depth": slis.get("slo.queue_depth"),
+        "queue_wait_p50_ms": slis.get("slo.queue_wait_p50_ms"),
+        "queue_wait_p99_ms": slis.get("slo.queue_wait_p99_ms"),
+        "dispatch_p99_ms": slis.get("slo.dispatch_p99_ms"),
+        "device_share": slis.get("slo.device_share"),
+        "decomposition": _window_decomposition(_slo),
+        "flush_causes": _flush_causes(reg),
         "dispatches": metric("serve.dispatches"),
         "coalesced_requests": metric("serve.coalesced_requests"),
         "batch_fill_ratio": metric("serve.batch_fill_ratio"),
@@ -149,6 +250,8 @@ def run_load(args):
         "evictions": metric("serve.evictions"),
     }
     svc.close()
+    if args.trace_dir:
+        rec["trace"] = obs.export_chrome_trace()
     if args.metrics_json:
         obs.dump_jsonl(args.metrics_json)
     print(json.dumps(rec), flush=True)
@@ -173,7 +276,7 @@ def _publish(staging, pub):
                     os.path.join(pub, name))
 
 
-def run_smoke():
+def run_smoke(args=None):
     """Sub-minute serving gate, exit nonzero on ANY broken invariant:
 
     1. N concurrent clients over 2 tenants with a 1-model LRU — every
@@ -181,10 +284,18 @@ def run_smoke():
     2. a checkpoint published MID-TRAFFIC hot-swaps in (watcher swap
        under the swap lock) without dropping or corrupting a request;
     3. the whole loaded phase — coalescing, evictions, re-admissions,
-       the swap — compiles ZERO XLA programs after warmup
-       (CompileWatch);
+       the swap, AND request tracing flipped ON mid-traffic — compiles
+       ZERO XLA programs after warmup (CompileWatch: enabling tracing
+       must add zero programs on the warm serve path);
     4. the live plane is real: slo.queue_depth sampled, cache
-       hits/evictions counted, heartbeat.serve stamped.
+       hits/evictions counted, heartbeat.serve stamped, flush causes
+       counted, and the queue-wait/dispatch/device-share decomposition
+       gauges derived from live windows;
+    5. tracing is affordable and honest: traced steady-state RPS
+       within 3% of the untraced window of the SAME run, and the
+       traced per-stage decomposition (queue-wait / coalesce /
+       checkout / dispatch / postprocess) sums to the measured
+       end-to-end p50 within 10%.
     """
     import tempfile
 
@@ -204,18 +315,68 @@ def run_smoke():
     # and the post-swap equality assert below has teeth
     staging = tempfile.mkdtemp(prefix="lgbm_serve_stage_")
     pub = tempfile.mkdtemp(prefix="lgbm_serve_pub_")
+    tdir = getattr(args, "trace_dir", "") if args is not None else ""
+    keep_trace = bool(tdir)
+    tdir = tdir or tempfile.mkdtemp(prefix="lgbm_serve_trace_")
     try:
         return _run_smoke_body(lgb, obs, CompileWatch, t0, X, y,
-                               rounds, leaves, bA, bB, staging, pub)
+                               rounds, leaves, bA, bB, staging, pub,
+                               tdir)
     finally:
         # check.sh runs this every invocation: leaked checkpoint dirs
         # would accumulate unbounded /tmp disk across CI runs
         shutil.rmtree(staging, ignore_errors=True)
         shutil.rmtree(pub, ignore_errors=True)
+        if not keep_trace:
+            shutil.rmtree(tdir, ignore_errors=True)
+
+
+def _steady_rps(lat, secs, svc, depth_box):
+    """Completed requests/sec over a ``secs`` window of the running
+    client load (the clients append to ``lat``), sampling queue depth
+    along the way."""
+    n0, t0 = len(lat), time.perf_counter()
+    end = t0 + secs
+    while time.perf_counter() < end:
+        depth_box[0] = max(depth_box[0], svc.queue.depth())
+        time.sleep(0.02)
+    return (len(lat) - n0) / (time.perf_counter() - t0)
+
+
+def _trace_overhead(svc, Xq, tracing_mod, alts=3, n=100):
+    """The tracing tax on steady-state serving: interleaved traced /
+    untraced windows of sequential requests on the SAME warm service,
+    compared on POOLED MEDIAN latency. (Windowed RPS on a loaded CI
+    box carries ±5-10% scheduler noise — far above the 3% bar the
+    gate enforces — while the per-request median is stable to ~1%,
+    and interleaving cancels slow drift.) Returns ``(overhead,
+    rps_untraced, rps_traced)`` where the RPS numbers are the
+    median-latency equivalents (1/median). Tracing is left ENABLED."""
+    import statistics
+
+    def window():
+        out = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            svc.predict("a", Xq, timeout=10.0)
+            out.append(time.perf_counter() - t0)
+        return out
+
+    untraced, traced = [], []
+    for _ in range(alts):
+        tracing_mod.disable_tracing()
+        untraced += window()
+        tracing_mod.enable_tracing()
+        traced += window()
+    mu = statistics.median(untraced)
+    mt = statistics.median(traced)
+    return mt / mu - 1.0, 1.0 / mu, 1.0 / mt
 
 
 def _run_smoke_body(lgb, obs, CompileWatch, t0, X, y, rounds, leaves,
-                    bA, bB, staging, pub):
+                    bA, bB, staging, pub, tdir):
+    from lightgbm_tpu.obs import slo as _slo
+    from lightgbm_tpu.obs import tracing as _tracing
     v2 = lgb.train({"objective": "binary", "num_leaves": leaves,
                     "verbosity": -1, "learning_rate": 0.05,
                     "checkpoint_dir": staging,
@@ -239,19 +400,37 @@ def _run_smoke_body(lgb, obs, CompileWatch, t0, X, y, rounds, leaves,
         target=_client, args=(svc, ["a", "b"], X, 64, stop, lat, drops,
                               100 + i), daemon=True)
         for i in range(4)]
-    depth_max = 0
+    depth_box = [0]
     with CompileWatch("serve-smoke") as w:
         for t in threads:
             t.start()
-        time.sleep(1.0)
+        time.sleep(0.5)
         _publish(staging, pub)          # the mid-traffic swap
-        t1 = time.time()
-        while time.time() - t1 < 2.0:
-            depth_max = max(depth_max, svc.queue.depth())
-            time.sleep(0.02)
+        # loaded window, then request tracing flips ON mid-traffic
+        # (inside the CompileWatch window — enabling it must add zero
+        # programs) and the load keeps running traced
+        rps_loaded = _steady_rps(lat, 1.0, svc, depth_box)
+        obs.enable(metrics=False, trace_dir=tdir)
+        rps_loaded_traced = _steady_rps(lat, 1.0, svc, depth_box)
         stop.set()
         for t in threads:
             t.join(timeout=30)
+        # tracing tax on the same warm service (sequential interleaved
+        # median-latency windows; one re-measure before failing — a
+        # REAL >3% tax reproduces, scheduler noise does not)
+        overhead, rps_untraced, rps_traced = \
+            _trace_overhead(svc, Xq, _tracing)
+        if overhead >= 0.03:
+            overhead, rps_untraced, rps_traced = \
+                _trace_overhead(svc, Xq, _tracing)
+        # sequential decomposition window (still traced, still inside
+        # the compile watch): one rider per batch, so stage durations
+        # pair 1:1 with requests and the trace yields EXACT per-stage
+        # p50s to check against end-to-end
+        n_ev = len(_tracing.events())
+        for _ in range(120):
+            svc.predict("a", Xq, timeout=10.0)
+        deco = _trace_decomposition(_tracing.events()[n_ev:])
     watcher = bA._model_watch
     reg = obs.registry()
 
@@ -266,6 +445,24 @@ def _run_smoke_body(lgb, obs, CompileWatch, t0, X, y, rounds, leaves,
     w.assert_compiles(0)                # zero warm-path programs
     assert reg.get("heartbeat.serve") is not None, \
         "dispatch loop never stamped heartbeat.serve"
+    # the request-lifecycle plane (docs/observability.md "Request
+    # tracing"): decomposition stages must SUM to what the caller
+    # experiences — a stage the spans miss would silently eat p99
+    # budget postmortems
+    assert deco is not None, "traced window recorded no complete batch"
+    e2e, ssum = deco["e2e_p50_ms"], deco["stage_sum_p50_ms"]
+    assert abs(ssum - e2e) <= 0.10 * e2e, \
+        f"stage p50s sum to {ssum}ms vs end-to-end {e2e}ms (>10% gap)"
+    assert overhead < 0.03, \
+        f"tracing overhead {overhead:.1%} >= 3% " \
+        f"({rps_traced:.0f} traced vs {rps_untraced:.0f} untraced RPS)"
+    causes = _flush_causes(reg)
+    assert causes and sum(causes.values()) >= 1, \
+        "no serve.flush_cause{cause=...} counters recorded"
+    slis = _slo.tracker().compute()
+    assert slis.get("slo.queue_wait_p99_ms") is not None, \
+        "queue-wait window empty: the decomposition gauges are dead"
+    assert slis.get("slo.device_share") is not None
     # post-swap serving must match the published model EXACTLY — a
     # swap that leaves a stale stack (or truncates adoption) serves
     # wrong values with the right shape, which only this catches
@@ -276,14 +473,27 @@ def _run_smoke_body(lgb, obs, CompileWatch, t0, X, y, rounds, leaves,
     assert not np.array_equal(expected, pre_swap), \
         "v2 indistinguishable from v1 — the swap assert has no teeth"
     svc.close()
+    trace_path = obs.export_chrome_trace()
     print(json.dumps({
         "serve_smoke": 1, "secs": round(time.time() - t0, 1),
         "requests": len(lat), "dropped": 0,
         "swaps": watcher.swaps,
         "evictions": metric("serve.evictions"),
         "cache_hits": metric("serve.cache_hits"),
-        "queue_depth_max": depth_max,
+        "queue_depth_max": depth_box[0],
         "warm_compiles": w.compiles,
+        "rps_loaded": round(rps_loaded, 1),
+        "rps_loaded_traced": round(rps_loaded_traced, 1),
+        "rps_untraced": round(rps_untraced, 1),
+        "rps_traced": round(rps_traced, 1),
+        "trace_overhead": round(max(overhead, 0.0), 4),
+        "queue_wait_p99_ms": round(
+            slis["slo.queue_wait_p99_ms"], 3),
+        "dispatch_p99_ms": slis.get("slo.dispatch_p99_ms"),
+        "device_share": round(slis["slo.device_share"], 4),
+        "flush_causes": causes,
+        "decomposition": deco,
+        "trace": trace_path,
         "post_swap_rows": int(np.shape(swapped)[0]),
     }), flush=True)
     return 0
@@ -309,11 +519,15 @@ def main():
                          "127.0.0.1:PORT for the duration of the run")
     ap.add_argument("--metrics-json", type=str, default="",
                     help="append one obs metrics-snapshot JSONL line")
+    ap.add_argument("--trace-dir", type=str, default="",
+                    help="enable request-lifecycle tracing and export "
+                         "a Chrome trace of the run there "
+                         "(docs/observability.md 'Request tracing')")
     ap.add_argument("--smoke", action="store_true",
                     help="fast CI gate (see run_smoke)")
     args = ap.parse_args()
     if args.smoke:
-        return run_smoke()
+        return run_smoke(args)
     return run_load(args)
 
 

@@ -10,7 +10,10 @@ Pillars, one import:
   the ``Booster.metrics()`` / ``GBDT.metrics_snapshot()`` APIs.
 - **Tracing** (obs/tracing.py): ``with obs.span("train/round",
   round=i):`` — nested spans that record wall time (plus optional
-  device-synced time) into a Chrome-trace JSON viewable in Perfetto.
+  device-synced time) into a Chrome-trace JSON viewable in Perfetto;
+  the serving dispatch loop adds per-batch span trees with rider
+  flow events, and rank-tagged exports merge into one gang-wide
+  timeline via ``scripts/trace_merge.py`` (obs/aggregate.py).
 - **Device telemetry** (obs/telemetry.py): compile-request counting,
   program-cache-size and HBM gauges refreshed into the registry.
 - **Active plane** (obs/slo.py + obs/server.py + obs/aggregate.py):
@@ -41,8 +44,8 @@ from . import metrics as _metrics
 from . import slo as _slo
 from . import tracing as _tracing
 from .metrics import prometheus_from_snapshot, registry
-from .tracing import (export_chrome_trace, span_stack, trace_dir,
-                      tracing_enabled)
+from .tracing import (export_chrome_trace, set_trace_rank, span_stack,
+                      trace_dir, trace_rank, tracing_enabled)
 
 __all__ = [
     "enable", "disable", "enabled", "any_enabled", "tracing_enabled",
@@ -53,7 +56,7 @@ __all__ = [
     "prometheus_text", "prometheus_from_snapshot",
     "export_chrome_trace", "export_state", "import_state", "reset",
     "configure_from_config", "flush_from_config", "span_stack",
-    "trace_dir",
+    "trace_dir", "set_trace_rank", "trace_rank",
 ]
 
 
@@ -150,6 +153,14 @@ class _Span:
     def __enter__(self) -> "_Span":
         self._t.start()
         return self
+
+    def set(self, **attrs) -> None:
+        """Attach attributes discovered mid-span (e.g. whether a
+        registry checkout was a cache hit) — they land in the trace
+        event recorded at exit. Callers must null-check the ``as``
+        value first: a disabled span is the shared nullcontext, whose
+        ``__enter__`` yields None."""
+        self._t.args.update(attrs)
 
     def __exit__(self, *exc) -> None:
         self._t.stop(_tracing.tracing_enabled(),

@@ -38,10 +38,12 @@ import re
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["merge_metrics", "merge_snapshots", "dump_rank_snapshot",
-           "read_rank_snapshots", "merge_rank_dir", "round_time_spread"]
+           "read_rank_snapshots", "merge_rank_dir", "round_time_spread",
+           "merge_chrome_traces", "read_rank_traces"]
 
 _RANK_FILE = "rank_{rank}.jsonl"
 _MERGED_FILE = "merged.jsonl"
+_RANK_TRACE_GLOB = "rank_*.trace.json"
 
 
 def _key(m: Dict[str, Any]) -> Tuple[str, str, Tuple[Tuple[str, str], ...]]:
@@ -222,3 +224,122 @@ def merge_rank_dir(directory: str,
         registry().dump_jsonl(
             os.path.join(str(directory), _MERGED_FILE), merged)
     return merged
+
+
+# ---------------------------------------------------------------------------
+# cross-rank Chrome-trace merge (scripts/trace_merge.py drives this)
+# ---------------------------------------------------------------------------
+def read_rank_traces(directory: str) -> List[str]:
+    """Paths of every ``rank_*.trace.json`` under ``directory``, rank
+    order (the per-rank exports obs/tracing.py writes when a trace
+    rank is set)."""
+    pattern = os.path.join(str(directory), _RANK_TRACE_GLOB)
+
+    def _rank_of(path: str) -> int:
+        m = re.search(r"rank_(\d+)\.trace\.json$", path)
+        return int(m.group(1)) if m else 1 << 30
+    return sorted(glob.glob(pattern), key=_rank_of)
+
+
+def merge_chrome_traces(paths: List[str]) -> Dict[str, Any]:
+    """Merge per-rank Chrome-trace exports into ONE Perfetto-loadable
+    timeline.
+
+    Each rank's event ``ts`` values sit on that process's OWN
+    monotonic clock — per-boot epochs that are NOT comparable across
+    hosts. Each export's envelope (``otherData``) records a wall
+    ``ts`` and ``monotonic`` stamp taken at the same instant — the
+    SAME rebase contract :func:`merge_snapshots` uses for gauge
+    stamps — so every event rebases to wall microseconds
+    (``(wall - monotonic) * 1e6 + ts``) before merging. The merged
+    document then shifts to a zero base (Perfetto renders offsets, not
+    epochs), keeps each rank's ``process_name``/``process_sort_index``
+    metadata rows (rank-named process rows), and sums the per-rank
+    dropped-event counts. A file without the envelope cannot rebase:
+    it overlays from the merged zero point (its own earliest event)
+    and is flagged in ``otherData.unrebased_ranks`` — visibly
+    misaligned beats silently dropped, and it must never anchor the
+    zero base (its raw monotonic epoch would shove the rebased ranks
+    decades off-screen).
+
+    Raises ValueError when no readable trace file was given."""
+    docs: List[Tuple[str, Dict[str, Any]]] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                docs.append((path, json.load(f)))
+        except (OSError, ValueError):
+            continue
+    if not docs:
+        raise ValueError("no readable Chrome-trace files to merge")
+    merged_events: List[Dict[str, Any]] = []
+    per_rank_events: List[Tuple[bool, List[Dict[str, Any]]]] = []
+    ranks: List[int] = []
+    unrebased: List[int] = []
+    dropped = 0
+    for idx, (path, doc) in enumerate(docs):
+        other = doc.get("otherData") or {}
+        rank = other.get("rank")
+        if rank is None:
+            # pre-rank-tagging export (or a hand-made file): key the
+            # process row off the file order so rows never collide
+            m = re.search(r"rank_(\d+)\.trace\.json$", path)
+            rank = int(m.group(1)) if m else idx
+        rank = int(rank)
+        ranks.append(rank)
+        dropped += int(other.get("dropped_events", 0) or 0)
+        wall, mono = other.get("ts"), other.get("monotonic")
+        rebased = wall is not None and mono is not None
+        if not rebased:
+            unrebased.append(rank)
+        off_us = ((float(wall) - float(mono)) * 1e6 if rebased
+                  else 0.0)
+        seen_process_name = False
+        timed: List[Dict[str, Any]] = []
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev, pid=rank)
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    seen_process_name = True
+            elif "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + off_us
+                timed.append(ev)
+            merged_events.append(ev)
+        per_rank_events.append((rebased, timed))
+        if not seen_process_name:
+            merged_events.append({
+                "name": "process_name", "ph": "M", "pid": rank,
+                "args": {"name": f"rank {rank}"}})
+    # zero-base the timeline over the REBASED ranks only: Perfetto
+    # displays offsets, and epoch wall microseconds would render as a
+    # useless 50-year pan — while an envelope-less rank's raw
+    # monotonic stamps, if allowed to anchor the minimum, would push
+    # every GOOD rank's events that same 50 years out the other way
+    rebased_ts = [e["ts"] for ok, timed in per_rank_events if ok
+                  for e in timed]
+    t0 = min(rebased_ts, default=None)
+    if t0 is None:
+        # nothing rebased: fall back to the global minimum
+        t0 = min((e["ts"] for _ok, timed in per_rank_events
+                  for e in timed), default=0.0)
+    for ok, timed in per_rank_events:
+        # an unrebased rank overlays from the zero point (its own
+        # earliest event) — visibly misaligned beats unviewable
+        base = t0 if ok else min((e["ts"] for e in timed),
+                                 default=t0)
+        for ev in timed:
+            ev["ts"] -= base
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": merged_events,
+        "otherData": {
+            "producer": "lightgbm-tpu obs trace_merge",
+            "merged_from_ranks": sorted(set(ranks)),
+            "dropped_events": dropped,
+            "unrebased_ranks": sorted(set(unrebased)),
+            # epoch of the zero point: wall seconds when any rank
+            # carried the envelope (absolute time is recoverable),
+            # the first rank's raw monotonic otherwise
+            "ts": t0 / 1e6,
+        },
+    }

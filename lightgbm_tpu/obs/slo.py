@@ -133,16 +133,32 @@ class SlidingHistogram(_TimeRing):
         self.bounds = b
         super().__init__(window_s, slots)
         self._counts = [[0] * len(b) for _ in range(self.slots)]
+        # exact per-slot value sums ride along with the bucket counts:
+        # windowed RATIOS of durations (e.g. slo.device_share =
+        # dispatch busy over batch busy) need sums, and deriving them
+        # from bucket midpoints would compound two bucket-width errors
+        self._sums = [0.0] * self.slots
 
     def _clear_slot(self, s: int) -> None:
         """Recycle one ring slot. Caller holds the lock (_slot_for)."""
         self._counts[s] = [0] * len(self.bounds)
+        self._sums[s] = 0.0
 
     def observe(self, v: float, now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now
         b = bisect_left(self.bounds, float(v))
         with self._lock:
-            self._counts[self._slot_for(now)][b] += 1
+            s = self._slot_for(now)
+            self._counts[s][b] += 1
+            self._sums[s] += float(v)
+
+    def total(self, window_s: Optional[float] = None,
+              now: Optional[float] = None) -> float:
+        """Windowed sum of observed values (exact, not bucket-derived)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return sum(self._sums[s]
+                       for s in self._valid_slots(window_s, now))
 
     def _window_counts(self, window_s: Optional[float],
                        now: float) -> List[int]:
@@ -229,8 +245,16 @@ class SlidingCounter(_TimeRing):
 # the tracker: watched names -> windows -> derived SLO gauges
 # ---------------------------------------------------------------------------
 # histogram feeds ride the EXISTING span names so the SLI and the
-# cumulative histogram measure the same events by construction
-WATCHED_HISTOGRAMS = ("predict/call", "train/round")
+# cumulative histogram measure the same events by construction. The
+# serve/* stages are the request-lifecycle decomposition the serving
+# dispatch loop records (serve/service.py): per-request queue wait
+# and end-to-end latency, per-batch coalesce/checkout/dispatch/
+# postprocess — windowing them is what turns "p99 breached" into
+# "p99 breached BECAUSE queue wait doubled" on a live /metrics scrape
+WATCHED_HISTOGRAMS = ("predict/call", "train/round",
+                      "serve/queue_wait", "serve/e2e", "serve/batch",
+                      "serve/coalesce", "serve/registry_checkout",
+                      "serve/dispatch", "serve/postprocess")
 WATCHED_COUNTERS = ("predict.requests", "predict.errors",
                     "predict.stack_cache_hits",
                     "predict.stack_cache_misses")
@@ -305,6 +329,9 @@ class SloTracker:
             (0.50, 0.95, 0.99), now=now)
         r50, r99 = self.hists["train/round"].quantiles(
             (0.50, 0.99), now=now)
+        qw50, qw99 = self.hists["serve/queue_wait"].quantiles(
+            (0.50, 0.99), now=now)
+        d99 = self.hists["serve/dispatch"].quantile(0.99, now=now)
 
         def ms(v):
             return None if v is None else v * 1000.0
@@ -313,12 +340,25 @@ class SloTracker:
         hits = self.counters["predict.stack_cache_hits"].total(now=now)
         misses = self.counters[
             "predict.stack_cache_misses"].total(now=now)
+        # device share: windowed dispatch busy over batch busy — of
+        # the dispatch loop's per-batch processing wall, the fraction
+        # spent inside the bucketed predict (the device-bound stage)
+        # vs host-side coalesce/checkout/postprocess. Same-unit sums
+        # (both per batch); queue pressure is the queue_wait gauges'
+        # separate axis.
+        disp_sum = self.hists["serve/dispatch"].total(now=now)
+        batch_sum = self.hists["serve/batch"].total(now=now)
         out: Dict[str, Any] = {
             "slo.predict_p50_ms": ms(p50),
             "slo.predict_p95_ms": ms(p95),
             "slo.predict_p99_ms": ms(p99),
             "slo.round_p50_s": r50,
             "slo.round_p99_s": r99,
+            "slo.queue_wait_p50_ms": ms(qw50),
+            "slo.queue_wait_p99_ms": ms(qw99),
+            "slo.dispatch_p99_ms": ms(d99),
+            "slo.device_share": (min(disp_sum / batch_sum, 1.0)
+                                 if batch_sum > 0 else None),
             "slo.error_ratio": (errors / requests if requests else None),
             "predict.cache_hit_ratio": (hits / (hits + misses)
                                         if (hits + misses) else None),

@@ -211,6 +211,12 @@ def run_worker(params: Dict, data_fn: Callable[[int, int], ShardSpec],
 
     rank = jax.process_index()
     nproc = jax.process_count()
+    # rank-tag this process's trace stream BEFORE training records any
+    # span: with tpu_trace_dir set, each worker exports
+    # rank_<r>.trace.json (rank-keyed pid + process_name rows) that
+    # scripts/trace_merge.py rebases into one gang-wide timeline
+    from ..obs import set_trace_rank
+    set_trace_rank(rank)
     shard = data_fn(rank, nproc)
     if not isinstance(shard, ShardSpec):
         shard = ShardSpec(**shard) if isinstance(shard, dict) \

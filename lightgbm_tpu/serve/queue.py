@@ -28,9 +28,20 @@ FIFO across models: the dispatcher always serves the model of the
 oldest queued request, so one chatty tenant cannot starve another.
 This module is pure queueing — no JAX, no engine; the dispatch itself
 lives in serve/service.py.
+
+Request lifecycle tracing (docs/observability.md "Request tracing"):
+every request is minted a process-unique ``id`` and stamps its
+enqueue time; when tracing is on, ``submit`` emits a flow-start event
+under that id (one bool check when off) which the dispatch loop's
+per-batch span closes — a coalesced rider's submit point visually
+connects to the batch that carried it in Perfetto. The pop classifies
+WHY the batch flushed (``flush_cause``: "fill" / "freeze" /
+"deadline" / "close") onto the popped requests so the dispatch can
+attribute latency to queue policy, not just measure it.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -39,23 +50,31 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import tracing as _tracing
+
 __all__ = ["PredictRequest", "MicroBatchQueue"]
+
+# process-unique request ids: the trace flow id AND the span attr that
+# lets one request be followed across submit -> batch -> resolve
+_req_ids = itertools.count(1)
 
 
 class PredictRequest:
     """One queued predict: rows + the future its caller blocks on."""
 
     __slots__ = ("model_id", "X", "rows", "future", "t_enqueue",
-                 "deadline", "dispatched")
+                 "deadline", "dispatched", "id", "flush_cause")
 
     def __init__(self, model_id: str, X, budget_s: float):
         self.model_id = str(model_id)
         self.X = X
         self.rows = int(np.shape(X)[0])
         self.future: Future = Future()
+        self.id = next(_req_ids)
         self.t_enqueue = time.monotonic()
         self.deadline = self.t_enqueue + max(float(budget_s), 0.0)
         self.dispatched = False
+        self.flush_cause: Optional[str] = None
 
 
 class MicroBatchQueue:
@@ -95,6 +114,14 @@ class MicroBatchQueue:
         with self._cond:
             if self._closed:
                 raise RuntimeError("serve queue is closed")
+            if _tracing.tracing_enabled():
+                # flow START on the CALLER's thread at enqueue time —
+                # AFTER the closed check, so a refused submit leaves
+                # no orphan arrow: the dispatch loop's batch span ends
+                # the flow (submit -> carrying-batch arrows per rider)
+                _tracing.record_flow("serve/req", req.id, "s",
+                                     {"model": req.model_id,
+                                      "rows": req.rows})
             d = self._by_model.get(req.model_id)
             if d is None:
                 d = self._by_model[req.model_id] = deque()
@@ -181,18 +208,27 @@ class MicroBatchQueue:
                     return None
             model_id = head.model_id
             # coalescing window: sleep toward the oldest deadline,
-            # waking on every submit to re-check the fill level
+            # waking on every submit to re-check the fill level. The
+            # exit branch IS the flush cause — stamped on the popped
+            # requests so the dispatch span can attribute the flush
+            # ("fill" = prefix reached the row cap, "freeze" = a
+            # non-fitting request ended the prefix, "deadline" = the
+            # oldest request's budget ran out, "close" = shutdown).
+            cause = "close"
             while not self._closed:
                 if self._prefix.get(model_id, 0) >= self.max_batch_rows:
+                    cause = "fill"
                     break
                 if not self._open.get(model_id, True):
                     # a non-fitting request FROZE the prefix — under
                     # strict FIFO nothing can ever join this batch, so
                     # waiting out the budget would be pure added
                     # latency for it AND the request blocked behind it
+                    cause = "freeze"
                     break
                 now = time.monotonic()
                 if now >= head.deadline:
+                    cause = "deadline"
                     break
                 self._cond.wait(head.deadline - now)
             d = self._by_model.get(model_id)
@@ -206,6 +242,7 @@ class MicroBatchQueue:
                     break           # prefix ends HERE: strict FIFO,
                 d.popleft()         # later requests never overtake r
                 r.dispatched = True
+                r.flush_cause = cause
                 batch.append(r)
                 rows += r.rows
                 if rows >= self.max_batch_rows:

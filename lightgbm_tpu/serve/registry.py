@@ -144,30 +144,37 @@ class ModelRegistry:
         which keeps the per-model lock held from admission through the
         predict. Use checkout for single-threaded callers and tests."""
         with self._lock:
-            return self._admit(model_id).booster
+            return self._admit(model_id)[0].booster
 
     def begin_dispatch(self, model_id: str):
         """Checkout for the serving dispatch loop: admit + LRU-touch,
-        then return ``(booster, lock)`` with the per-model lock
+        then return ``(booster, lock, hit)`` with the per-model lock
         ALREADY HELD — the caller releases it after its predict. The
         lock is continuous from admission through the predict, so an
         evict() between the two cannot release a stack the predict is
         about to repopulate (which would leave real HBM residency
-        accounted as zero)."""
+        accounted as zero). ``hit`` says whether the checkout found
+        the forest device-resident (vs a re-admission re-stack) — the
+        dispatch loop's ``serve/registry_checkout`` span records it,
+        so an LRU-thrash p99 breach is visible per batch in the
+        trace, not only as cumulative eviction counters."""
         with self._lock:
-            entry = self._admit(model_id)
+            entry, hit = self._admit(model_id)
             entry.lock.acquire()    # registry -> entry order, held out
-            return entry.booster, entry.lock
+            return entry.booster, entry.lock, hit
 
-    def _admit(self, model_id: str) -> "_Entry":
-        """LRU-touch + device-forest admission. Caller holds the
-        registry lock."""
+    def _admit(self, model_id: str):
+        """LRU-touch + device-forest admission; returns
+        ``(entry, hit)`` where ``hit`` means the stacked forest was
+        already device-resident under its current stack key. Caller
+        holds the registry lock."""
         entry = self._entries.get(str(model_id))
         if entry is None:
             raise KeyError(f"model {model_id!r} is not registered")
         self._entries.move_to_end(entry.model_id)
         key = self._stack_key(entry.booster)
-        if entry.resident and key == entry.key:
+        hit = bool(entry.resident and key == entry.key)
+        if hit:
             obs.inc("serve.cache_hits")
         else:
             # admission (first touch, post-eviction re-admission, or a
@@ -187,7 +194,7 @@ class ModelRegistry:
             entry.resident = True
             self._enforce_caps(keep=entry.model_id)
         self._refresh_gauges()
-        return entry
+        return entry, hit
 
     def evict(self, model_id: str) -> bool:
         """Explicitly release one model's device forest (it stays

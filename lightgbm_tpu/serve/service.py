@@ -19,10 +19,26 @@ the dispatch loop stamps ``heartbeat.serve`` (so ``/readyz`` turns
 green after :meth:`warmup` — the PR 13 readiness-by-warmup contract),
 and every dispatch records ``serve.dispatches`` /
 ``serve.coalesced_requests`` / ``serve.batch_fill_ratio``.
+
+Request-lifecycle tracing (docs/observability.md "Request tracing"):
+each dispatched batch runs under ONE ``serve/batch`` span whose
+children decompose it — per-rider ``serve/queue_wait`` (recorded
+retroactively from the request's enqueue stamp), ``serve/coalesce``
+(riders / rows / fill / flush cause), ``serve/registry_checkout``
+(hit vs re-admission re-stack), ``serve/dispatch`` (the bucketed
+predict), and ``serve/postprocess`` (slice + resolve). Riders attach
+to their carrying batch as flow events, and the same stage durations
+feed the PR 11 sliding windows so ``SloTracker.evaluate()`` derives
+``slo.queue_wait_p50|p99_ms`` / ``slo.dispatch_p99_ms`` /
+``slo.device_share`` and the ``serve.flush_cause{cause=...}``
+counters — the p99 decomposition is live on ``/metrics``, not only
+in trace files. All of it is off by default behind the existing obs
+gates (one bool check per site when off).
 """
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from concurrent.futures import Future
 from typing import List, Optional
@@ -32,6 +48,7 @@ import numpy as np
 from .. import obs
 from ..config import Config
 from ..obs import slo as _slo
+from ..obs import tracing as _tracing
 from ..utils import log
 from .queue import MicroBatchQueue, PredictRequest
 from .registry import ModelRegistry
@@ -220,22 +237,76 @@ class PredictService:
                             f"{model_id!r} failed ({e})")
 
     def _dispatch(self, model_id: str,
-                  batch: List[PredictRequest]) -> None:
+                  batch: List[PredictRequest],
+                  admitted: bool = False) -> None:
         rows = sum(r.rows for r in batch)
-        if len(batch) == 1:
-            X = batch[0].X
-        else:
+        # the queue stamped WHY it flushed onto the popped requests;
+        # warmup-era direct calls (tests) may carry none
+        cause = batch[0].flush_cause or "fill"
+        with obs.span("serve/batch", model=model_id, riders=len(batch),
+                      rows=rows, cause=cause, req=batch[0].id) as bsp:
+            if not admitted and obs.any_enabled():
+                self._admission_records(batch)
+            X = self._coalesce(batch, rows, cause)
+            if X is None and bsp is not None:
+                bsp.set(shattered=True)
+            if X is not None:
+                self._dispatch_batch(model_id, batch, X, rows, cause)
+        if X is None:
+            # one malformed rider (wrong column count, ragged
+            # payload) must not poison its batchmates: dispatch
+            # each request alone so only the offender's future
+            # fails, with the engine's own error. admitted=True:
+            # queue waits / flow ends were already recorded for the
+            # shattered batch — re-recording would double-feed the
+            # SLO windows and duplicate flow finishes
+            for req in batch:
+                self._dispatch(model_id, [req], admitted=True)
+
+    def _admission_records(self, batch: List[PredictRequest]) -> None:
+        """Per-rider admission instrumentation, under the open
+        ``serve/batch`` span: the queue-wait stage (feeds the metrics
+        histogram + the SLO sliding window) and, when tracing, a
+        RETROACTIVE ``serve/queue_wait`` event spanning enqueue→now on
+        the virtual "serve queue" track (its own Perfetto row — waits
+        overlap the previous batch's spans on the dispatch thread)
+        plus the flow end tying each rider's submit to this batch."""
+        now = time.monotonic()
+        tracing = _tracing.tracing_enabled()
+        qtid = _tracing.track_tid("serve queue") if tracing else 0
+        for req in batch:
+            wait = max(now - req.t_enqueue, 0.0)
+            obs.observe("serve/queue_wait", wait)
+            if tracing:
+                _tracing.record_event(
+                    "serve/queue_wait", req.t_enqueue, wait,
+                    {"parent": "serve/batch", "req": req.id,
+                     "model": req.model_id, "rows": req.rows},
+                    tid=qtid)
+                _tracing.record_flow("serve/req", req.id, "f")
+
+    def _coalesce(self, batch: List[PredictRequest], rows: int,
+                  cause: str):
+        """Concatenate the riders into one payload (None = a malformed
+        rider; the caller shatters the batch). ``fill`` is estimated
+        against the SERVICE config's bucket ladder — the dispatched
+        booster (whose knobs decide the real padding) is not checked
+        out yet; ``serve.batch_fill_ratio`` stays the exact number."""
+        with obs.span("serve/coalesce", riders=len(batch), rows=rows,
+                      cause=cause,
+                      fill=round(rows / float(self._bucket_rows(rows)),
+                                 4)):
+            if len(batch) == 1:
+                return batch[0].X
             try:
-                X = np.concatenate([np.asarray(r.X) for r in batch],
-                                   axis=0)
+                return np.concatenate([np.asarray(r.X) for r in batch],
+                                      axis=0)
             except Exception:
-                # one malformed rider (wrong column count, ragged
-                # payload) must not poison its batchmates: dispatch
-                # each request alone so only the offender's future
-                # fails, with the engine's own error
-                for req in batch:
-                    self._dispatch(model_id, [req])
-                return
+                return None
+
+    def _dispatch_batch(self, model_id: str,
+                        batch: List[PredictRequest], X, rows: int,
+                        cause: str) -> None:
         try:
             # admission and predict under ONE continuous hold of the
             # model's registry lock (begin_dispatch) — register() /
@@ -247,39 +318,55 @@ class PredictService:
             # the whole model read (basic.py), so a concurrent
             # hot-swap lands before or after the WHOLE batch: every
             # rider sees one model.
-            booster, lock = self.registry.begin_dispatch(model_id)
+            with obs.span("serve/registry_checkout",
+                          model=model_id) as ck:
+                booster, lock, hit = \
+                    self.registry.begin_dispatch(model_id)
+                if ck is not None:
+                    ck.set(hit=hit)
         except KeyError as e:
             for req in batch:
                 _resolve(req, exc=e)
             return
         try:
-            out = booster.predict(X)
+            with obs.span("serve/dispatch", rows=rows,
+                          riders=len(batch)):
+                out = booster.predict(X)
         except Exception as e:
             for req in batch:
                 _resolve(req, exc=e)
-            self._record(batch, rows, booster)
+            self._record(batch, rows, booster, cause)
             return
         finally:
             lock.release()
-        off = 0
-        for req in batch:
-            part = out[off:off + req.rows]
-            # coalesced riders get COPIES: independent callers must
-            # not hold aliasing views of one shared batch buffer (an
-            # in-place tweak by one would corrupt its batchmates, and
-            # a retained slice would pin the whole batch)
-            _resolve(req, value=(part.copy() if len(batch) > 1
-                                 else part))
-            off += req.rows
-        self._record(batch, rows, booster)
+        with obs.span("serve/postprocess", riders=len(batch)):
+            off = 0
+            for req in batch:
+                part = out[off:off + req.rows]
+                # coalesced riders get COPIES: independent callers must
+                # not hold aliasing views of one shared batch buffer (an
+                # in-place tweak by one would corrupt its batchmates, and
+                # a retained slice would pin the whole batch)
+                _resolve(req, value=(part.copy() if len(batch) > 1
+                                     else part))
+                off += req.rows
+        self._record(batch, rows, booster, cause)
 
     def _record(self, batch: List[PredictRequest], rows: int,
-                booster=None) -> None:
+                booster=None, cause: str = "fill") -> None:
         obs.inc("serve.dispatches")
         if len(batch) > 1:
             obs.inc("serve.coalesced_requests", len(batch))
         obs.set_gauge("serve.batch_fill_ratio",
                       rows / float(self._bucket_rows(rows, booster)))
+        if obs.enabled():
+            # flush-cause taxonomy + per-rider end-to-end latency: the
+            # decomposition the slo.* gauges derive from (one bool
+            # gate for the per-request loop)
+            obs.inc("serve.flush_cause", cause=cause)
+            now = time.monotonic()
+            for req in batch:
+                obs.observe("serve/e2e", max(now - req.t_enqueue, 0.0))
         # liveness from the LOOP, not just the predict instrumentation:
         # /readyz must track "the dispatcher is draining work" even
         # with a model whose predicts error

@@ -71,12 +71,17 @@ python benchmarks/chaos_bench.py --smoke || CHAOS_SMOKE=0
 
 # serving smoke (docs/serving.md): N concurrent clients through the
 # micro-batching service with a 1-model LRU and a mid-traffic hot-swap
-# — zero dropped requests, zero warm-path compiles; its status rides
-# the obs line so scripts/obs_trend.py fails absolutely on
-# serve_smoke=0
+# — zero dropped requests, zero warm-path compiles, tracing overhead
+# under 3%, stage decomposition summing to end-to-end; its status
+# rides the obs line so scripts/obs_trend.py fails absolutely on
+# serve_smoke=0, and its windowed queue-wait p99 rides along as
+# queue_wait_p99_ms= so the sentinel catches queue-pressure creep
 SERVE_SMOKE=1
+SERVE_JSON=/tmp/_check_serve_smoke.log
+rm -f "$SERVE_JSON"
 JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
-python benchmarks/serve_bench.py --smoke || SERVE_SMOKE=0
+python benchmarks/serve_bench.py --smoke 2>&1 | tee "$SERVE_JSON" \
+  || SERVE_SMOKE=0
 
 # static analysis (docs/static-analysis.md): the five drift linters —
 # capability-gate / config-knobs / obs-names / collective-safety /
@@ -93,9 +98,10 @@ LINT_FINDINGS=$(cat "$LINT_COUNT_FILE" 2>/dev/null || echo -1)
 # dots/seconds from this run plus compile count and peak-HBM estimate
 # read back from the snapshot. A malformed dump FAILS the gate — a
 # check that silently skips its own telemetry is how telemetry rots.
-python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" <<'PY' >> scripts/check_timings.log
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" "$SERVE_JSON" <<'PY' >> scripts/check_timings.log
 import json, sys, time
 path, mode, dots, secs, rev, stream_ok, chaos_ok, lint, serve_ok = sys.argv[1:10]
+serve_json = sys.argv[10] if len(sys.argv) > 10 else ""
 try:
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     snap = json.loads(lines[-1])
@@ -111,6 +117,17 @@ def gauge(name):
         if m.get("name") == name and not m.get("labels"):
             return m.get("value")
     return None
+
+def serve_stat(key):
+    """Read one field off the serving smoke's final JSON record (the
+    queue-wait p99 decomposition signal); a failed/absent smoke run
+    yields None — obs_trend skips missing signals, never crashes."""
+    try:
+        lines = [ln for ln in open(serve_json).read().splitlines()
+                 if ln.strip().startswith("{")]
+        return json.loads(lines[-1]).get(key)
+    except Exception:
+        return None
 
 print("obs " + json.dumps({
     "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -139,6 +156,10 @@ print("obs " + json.dumps({
     # concurrent serving: coalesce + evict + swap under load with zero
     # drops and zero warm compiles (benchmarks/serve_bench.py --smoke)
     "serve_smoke": int(serve_ok),
+    # windowed serving queue-wait p99 from the smoke's SLO plane —
+    # obs_trend.py flags it regressing past its trailing median
+    # (queue-pressure creep: budget misconfig, dispatch slowdown)
+    "queue_wait_p99_ms": serve_stat("queue_wait_p99_ms"),
     # drift-linter findings (python -m tools.analyze; -1 = analyzer
     # crashed). obs_trend.py fails absolutely on anything but 0
     "lint_findings": int(lint),

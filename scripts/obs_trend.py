@@ -20,6 +20,12 @@ regressed past its threshold —
   "Iteration floor") UP by more than ``--max-copy-up`` (fraction)
   plus ``--copy-slack`` absolute (the share sits near zero once
   donation lands; a pure ratio would flag noise);
+- ``queue_wait_p99_ms`` (the serving smoke's windowed queue-wait p99,
+  docs/observability.md "Request tracing") UP by more than
+  ``--max-qw-up`` (fraction) plus ``--qw-slack-ms`` absolute — the
+  same near-zero-slack shape as the copy_share guard: the p99 sits
+  near the micro-batch budget, so a pure ratio would flag timer
+  jitter while a pure absolute would miss a doubling;
 - ``secs`` (suite wall clock) UP by more than ``--max-secs-up`` at a
   non-lower dot count (fewer dots = different suite, not a slowdown);
 - ``stream_dryrun`` == 0 in the NEWEST run (absolute, no baseline
@@ -54,6 +60,7 @@ Usage (scripts/check.sh runs it behind CHECK_TREND=1):
         [--window 5] [--max-ips-drop 0.15] [--max-compile-up 0.5]
         [--compile-slack 2] [--max-hbm-up 0.2] [--max-secs-up 0.35]
         [--max-copy-up 0.5] [--copy-slack 0.005]
+        [--max-qw-up 0.5] [--qw-slack-ms 2.0]
 Exit codes: 0 = no regression (or no history), 1 = regression, 2 = bad
 invocation (unreadable log path given explicitly).
 """
@@ -125,7 +132,8 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
                 max_ips_drop: float, max_compile_up: float,
                 compile_slack: float, max_hbm_up: float,
                 max_secs_up: float, max_copy_up: float = 0.5,
-                copy_slack: float = 0.005) -> List[str]:
+                copy_slack: float = 0.005, max_qw_up: float = 0.5,
+                qw_slack_ms: float = 2.0) -> List[str]:
     """Regression messages for the newest entry vs the trailing median
     of up to ``window`` earlier same-mode entries; [] = green."""
     if not entries:
@@ -211,6 +219,19 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
                 f"gate dropped a carry (docs/perf.md 'Iteration "
                 f"floor')")
 
+    qw_now = _num(newest, "queue_wait_p99_ms")
+    qw_med = _median_of(history, "queue_wait_p99_ms")
+    if qw_now is not None and qw_med is not None:
+        ceil = qw_med * (1.0 + max_qw_up) + qw_slack_ms
+        if qw_now > ceil:
+            failures.append(
+                f"queue_wait_p99_ms regressed: {qw_now:.3g} > "
+                f"{ceil:.3g} (trailing median {qw_med:.3g} over "
+                f"{len(history)} run(s)): serving queue pressure "
+                f"crept up — budget misconfig, dispatch slowdown, or "
+                f"LRU thrash (docs/observability.md 'Request "
+                f"tracing')")
+
     hbm_now = _num(newest, "peak_hbm_gib")
     hbm_med = _median_of(history, "peak_hbm_gib")
     if hbm_now is not None and hbm_med:
@@ -253,6 +274,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="absolute copy_share headroom on top of the "
                          "ratio (the share sits near zero once "
                          "donation lands)")
+    ap.add_argument("--max-qw-up", type=float, default=0.5)
+    ap.add_argument("--qw-slack-ms", type=float, default=2.0,
+                    help="absolute queue_wait_p99_ms headroom on top "
+                         "of the ratio (the p99 sits near the "
+                         "micro-batch budget; pure ratios would flag "
+                         "timer jitter)")
     args = ap.parse_args(argv)
 
     try:
@@ -276,7 +303,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     failures = check_trend(entries, args.window, args.max_ips_drop,
                            args.max_compile_up, args.compile_slack,
                            args.max_hbm_up, args.max_secs_up,
-                           args.max_copy_up, args.copy_slack)
+                           args.max_copy_up, args.copy_slack,
+                           args.max_qw_up, args.qw_slack_ms)
     if failures:
         for msg in failures:
             print(f"obs_trend: REGRESSION — {msg}")

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Merge per-rank Chrome traces into one Perfetto timeline.
+
+A ``train_distributed`` gang run with ``tpu_trace_dir=DIR`` leaves one
+``rank_<r>.trace.json`` per worker, each on its OWN monotonic clock —
+loading two of them into Perfetto separately tells you nothing about
+relative timing, and loading them together used to interleave garbage
+(identical pid/tid before the rank-tagged export). This CLI merges
+them into ONE timeline: every rank's timestamps rebase through the
+export envelope's wall/monotonic clock pair (the same rebase the
+gauge merge in obs/aggregate.py uses), each rank gets its own named
+process row, and the zero point is the earliest event across the gang
+— so a straggling rank shows up as its ``train/round`` spans visibly
+lagging the others in one Perfetto window.
+
+    python scripts/trace_merge.py /tmp/trace              # a trace dir
+    python scripts/trace_merge.py /tmp/trace -o gang.json
+    python scripts/trace_merge.py rank_0.trace.json rank_1.trace.json
+
+With a directory argument, every ``rank_*.trace.json`` inside is
+merged; default output is ``<dir>/merged.trace.json`` (or
+``merged.trace.json`` in the cwd for explicit file lists). Open the
+output at <https://ui.perfetto.dev>.
+
+Exit codes: 0 = merged, 3 = nothing to merge (no rank trace files),
+2 = bad invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.obs.aggregate import (  # noqa: E402
+    merge_chrome_traces, read_rank_traces)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-rank Chrome traces into one "
+                    "Perfetto-loadable timeline (see module docstring)")
+    ap.add_argument("paths", nargs="+",
+                    help="a tpu_trace_dir (rank_*.trace.json inside "
+                         "is merged) or explicit trace files")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: merged.trace.json "
+                         "next to the inputs)")
+    args = ap.parse_args(argv)
+
+    files = []
+    for p in args.paths:
+        if os.path.isdir(p):
+            files.extend(read_rank_traces(p))
+        else:
+            files.append(p)
+    if not files:
+        sys.stderr.write("trace_merge: no rank_*.trace.json files "
+                         "found\n")
+        return 3
+    try:
+        merged = merge_chrome_traces(files)
+    except ValueError as e:
+        sys.stderr.write(f"trace_merge: {e}\n")
+        return 3
+    out = args.out
+    if out is None:
+        base = args.paths[0] if os.path.isdir(args.paths[0]) \
+            else os.path.dirname(os.path.abspath(files[0]))
+        out = os.path.join(base, "merged.trace.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out)
+    other = merged["otherData"]
+    n_events = sum(1 for e in merged["traceEvents"]
+                   if e.get("ph") != "M")
+    print(json.dumps({
+        "out": out,
+        "ranks": other["merged_from_ranks"],
+        "events": n_events,
+        "dropped_events": other["dropped_events"],
+        "unrebased_ranks": other["unrebased_ranks"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

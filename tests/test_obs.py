@@ -161,8 +161,15 @@ def test_span_nesting_and_chrome_trace_schema(tmp_path):
     assert out is not None and out.endswith(".json")
     doc = json.load(open(out))
     # Perfetto/chrome://tracing JSON object form: a traceEvents list of
-    # complete events with microsecond ts/dur
-    events = {e["name"]: e for e in doc["traceEvents"]}
+    # complete events with microsecond ts/dur, plus the process/thread
+    # naming metadata rows the export prepends
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in meta)
+    # the export envelope carries the wall/monotonic pair the
+    # cross-rank merger rebases on (scripts/trace_merge.py)
+    assert {"ts", "monotonic"} <= set(doc["otherData"])
+    events = {e["name"]: e for e in doc["traceEvents"]
+              if e["ph"] == "X"}
     assert set(events) == {"outer", "inner"}
     for e in events.values():
         assert e["ph"] == "X"
@@ -185,7 +192,10 @@ def test_trace_buffer_bounded_and_dropped_counted(monkeypatch):
     for i in range(9):
         with obs.span(f"s{i}"):
             pass
-    assert len(obs_tracing.events()) == 4
+    # oldest-dropped: a long-lived process keeps its most RECENT
+    # window (the one a p99 postmortem needs), counting the evictions
+    assert [e["name"] for e in obs_tracing.events()] == \
+        ["s5", "s6", "s7", "s8"]
     assert obs_tracing.dropped_events() == 5
 
 

@@ -122,6 +122,33 @@ def test_copy_share_regression_fails(tmp_path):
     assert rc == 0, out
 
 
+def test_queue_wait_p99_regression_fails(tmp_path):
+    """The serving queue-pressure sentinel (docs/observability.md
+    "Request tracing"): the smoke's windowed queue-wait p99 regressing
+    past its trailing median (ratio + absolute ms slack, the same
+    shape as the copy_share guard) fails; jitter within the slack and
+    histories without the signal stay green."""
+    def _with_qw(ms):
+        e = json.loads(_obs_line()[len("obs "):])
+        e["queue_wait_p99_ms"] = ms
+        return "obs " + json.dumps(e)
+
+    base = [_with_qw(5.0) for _ in range(4)]
+    # 5.0 * 1.5 + 2.0 = 9.5 ceiling: a doubled-plus p99 (budget
+    # misconfig / dispatch slowdown / LRU thrash) must fail
+    rc, out = _run(tmp_path, base + [_with_qw(12.0)])
+    assert rc == 1 and "queue_wait_p99_ms regressed" in out
+    # within ratio+slack stays green (near-budget timer jitter)
+    rc, out = _run(tmp_path, base + [_with_qw(7.0)])
+    assert rc == 0, out
+    # signal absent on either side -> skipped, like the other gauges
+    rc, out = _run(tmp_path, base + [_obs_line()])
+    assert rc == 0, out
+    rc, out = _run(tmp_path, [_obs_line() for _ in range(4)]
+                   + [_with_qw(12.0)])
+    assert rc == 0, out
+
+
 def test_wall_clock_regression_needs_same_or_more_dots(tmp_path):
     base = [_obs_line(secs=300, dots=38) for _ in range(4)]
     rc, out = _run(tmp_path, base + [_obs_line(secs=600, dots=38)])

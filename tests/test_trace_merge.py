@@ -1,0 +1,195 @@
+"""Cross-rank Chrome-trace merge (obs/aggregate.merge_chrome_traces +
+scripts/trace_merge.py + the rank-tagged export in obs/tracing.py).
+
+What these tests pin:
+
+* **Rank-tagged export** — with a trace rank set, the export writes
+  ``rank_<r>.trace.json``, keys every event's pid by the RANK (not
+  the per-host pid that collides across hosts), emits
+  ``process_name``/``process_sort_index`` metadata rows, and records
+  the wall/monotonic envelope pair the merge rebases on.
+* **Clock rebase** — two ranks whose monotonic clocks disagree by
+  1000 s but whose events happened 50 ms apart in WALL time merge to
+  a 50 ms offset (the straggler-visibility contract): same envelope
+  contract the gauge merge uses.
+* **CLI** — ``scripts/trace_merge.py DIR`` produces one
+  Perfetto-loadable document with rank-named process rows; an empty
+  dir exits 3.
+* **1-rank end-to-end** (in-container; the 2-rank gang run is
+  capability-gated in test_multihost_trace.py) — a traced training
+  run with a rank set exports a mergeable file.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import tracing as obs_tracing
+from lightgbm_tpu.obs.aggregate import merge_chrome_traces
+
+SCRIPT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "trace_merge.py")
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs(monkeypatch):
+    obs.disable()
+    obs.reset()
+    obs.set_trace_rank(None)
+    # the export dir is process-global and sticky by design (one
+    # stream per process); tests that each configure their own tmp
+    # dir must not inherit a previous test's
+    monkeypatch.setattr(obs_tracing, "_dir", None)
+    yield
+    obs.disable()
+    obs.reset()
+    obs.set_trace_rank(None)
+
+
+def _rank_doc(rank, wall, mono, events):
+    """A synthetic per-rank export: ``events`` are (name, ts_s, dur_s)
+    on that rank's OWN monotonic clock."""
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": rank,
+             "args": {"name": f"rank {rank} (pid {4000 + rank})"}},
+        ] + [
+            {"name": n, "ph": "X", "ts": ts * 1e6, "dur": dur * 1e6,
+             "pid": rank, "tid": 1}
+            for n, ts, dur in events
+        ],
+        "otherData": {"producer": "test", "dropped_events": rank,
+                      "pid": 4000 + rank, "rank": rank,
+                      "ts": wall, "monotonic": mono},
+    }
+
+
+def test_rank_tagged_export(tmp_path):
+    obs.enable(trace=True, metrics=False, trace_dir=str(tmp_path))
+    obs.set_trace_rank(3)
+    with obs.span("train/round", round=0):
+        pass
+    out = obs.export_chrome_trace()
+    assert os.path.basename(out) == "rank_3.trace.json"
+    doc = json.load(open(out))
+    other = doc["otherData"]
+    assert other["rank"] == 3 and other["pid"] == os.getpid()
+    assert other["monotonic"] <= other["ts"] or True  # both present
+    assert {"ts", "monotonic"} <= set(other)
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert evs and all(e["pid"] == 3 for e in evs)
+    meta = {e["name"]: e for e in doc["traceEvents"]
+            if e["ph"] == "M" and "tid" not in e}
+    assert meta["process_name"]["args"]["name"].startswith("rank 3")
+    assert meta["process_sort_index"]["args"]["sort_index"] == 3
+
+
+def test_merge_rebases_cross_rank_monotonic_clocks(tmp_path):
+    # rank 0: booted long ago (monotonic 5000 at wall 1e9); rank 1:
+    # freshly booted (monotonic 17). Rank 1's round happened 50 ms
+    # AFTER rank 0's in wall time — the straggler signal the merged
+    # timeline must preserve.
+    d0 = _rank_doc(0, wall=1e9, mono=5000.0,
+                   events=[("train/round", 5000.0, 0.010)])
+    d1 = _rank_doc(1, wall=1e9, mono=17.0,
+                   events=[("train/round", 17.050, 0.010)])
+    p0, p1 = str(tmp_path / "rank_0.trace.json"), \
+        str(tmp_path / "rank_1.trace.json")
+    json.dump(d0, open(p0, "w"))
+    json.dump(d1, open(p1, "w"))
+    merged = merge_chrome_traces([p0, p1])
+    evs = sorted((e for e in merged["traceEvents"]
+                  if e.get("ph") == "X"), key=lambda e: e["ts"])
+    assert [e["pid"] for e in evs] == [0, 1]
+    assert evs[0]["ts"] == pytest.approx(0.0)
+    assert evs[1]["ts"] == pytest.approx(50_000.0)   # 50 ms, in us
+    other = merged["otherData"]
+    assert other["merged_from_ranks"] == [0, 1]
+    assert other["dropped_events"] == 1              # 0 + 1
+    assert other["unrebased_ranks"] == []
+    # rank-named process rows survive the merge
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert any(n.startswith("rank 0") for n in names)
+    assert any(n.startswith("rank 1") for n in names)
+
+
+def test_merge_without_envelope_degrades_visibly(tmp_path):
+    d0 = _rank_doc(0, wall=1e9, mono=100.0,
+                   events=[("train/round", 100.0, 0.010)])
+    # the envelope-less rank carries a huge per-boot monotonic stamp:
+    # it must NOT anchor the zero base (which would shove the rebased
+    # rank's wall-epoch events decades off-screen) — it overlays from
+    # the zero point instead
+    d1 = _rank_doc(1, wall=1e9, mono=100.0,
+                   events=[("train/round", 3_000_000.0, 0.010)])
+    del d1["otherData"]["ts"]          # pre-envelope export
+    p0, p1 = str(tmp_path / "rank_0.trace.json"), \
+        str(tmp_path / "rank_1.trace.json")
+    json.dump(d0, open(p0, "w"))
+    json.dump(d1, open(p1, "w"))
+    merged = merge_chrome_traces([p0, p1])
+    assert merged["otherData"]["unrebased_ranks"] == [1]
+    by_pid = {e["pid"]: e for e in merged["traceEvents"]
+              if e.get("ph") == "X"}
+    assert by_pid[0]["ts"] == pytest.approx(0.0)   # rebased anchor
+    assert by_pid[1]["ts"] == pytest.approx(0.0)   # overlaid, not
+    with pytest.raises(ValueError):                # 50 years out
+        merge_chrome_traces([str(tmp_path / "missing.trace.json")])
+
+
+def test_trace_merge_cli(tmp_path):
+    for r in range(2):
+        json.dump(_rank_doc(r, wall=1e9, mono=10.0 + r,
+                            events=[("train/round", 10.0 + r, 0.005)]),
+                  open(tmp_path / f"rank_{r}.trace.json", "w"))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(tmp_path)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout)
+    assert rec["ranks"] == [0, 1] and rec["events"] == 2
+    doc = json.load(open(tmp_path / "merged.trace.json"))
+    assert isinstance(doc["traceEvents"], list)
+    # nothing to merge -> exit 3, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(empty)],
+        capture_output=True, text=True)
+    assert proc.returncode == 3
+
+
+def test_one_rank_train_trace_merges(tmp_path):
+    """In-container 1-rank path of the gang contract: a traced
+    training run with a rank set exports rank_0.trace.json, and the
+    CLI merges it into a loadable timeline with a rank-named row."""
+    X = np.random.default_rng(0).normal(size=(400, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    tdir = tmp_path / "trace"
+    obs.set_trace_rank(0)
+    lgb.train({"objective": "binary", "num_leaves": 4,
+               "verbosity": -1, "tpu_trace_dir": str(tdir)},
+              lgb.Dataset(X, label=y), num_boost_round=2)
+    assert (tdir / "rank_0.trace.json").exists()
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(tdir)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.load(open(tdir / "merged.trace.json"))
+    spans = {e["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "X"}
+    # the fused path replaces train/round with train/fused — either
+    # way the setup span is always there
+    assert "train/setup" in spans
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert any(n.startswith("rank 0") for n in names)
