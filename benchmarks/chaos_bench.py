@@ -186,12 +186,30 @@ def run_smoke():
     2. a warm server hot-swaps the published model with zero dropped
        requests and zero warm-path recompiles (CompileWatch);
     3. a corrupted publish keeps the previous model serving and flips
-       serve.model_stale.
+       serve.model_stale;
+    4. an ELASTIC RESIZE cycle (docs/robustness.md "Elastic
+       topology"): a 4-shard streamed×sharded run killed mid-run
+       resumes at 2 shards through the topology re-cut path,
+       BIT-IDENTICAL (quantized gradients) to the uninterrupted
+       4-shard run, and the narrower publish hot-swaps into a warm
+       server with zero dropped predicts — reported as
+       ``elastic_smoke`` in the final record (scripts/check.sh puts
+       it on the obs line; scripts/obs_trend.py fails absolutely on
+       ``elastic_smoke=0``).
 
     (The true-SIGKILL + watchdog variants live in tests/test_chaos.py
     gang tests; this smoke stays in-process for speed.)
     """
+    import os
     import tempfile
+
+    # the resize cycle shards a 4-wide mesh: give XLA fake host
+    # devices when the environment has none (check.sh runs this on a
+    # bare CPU; a real multi-chip host keeps its real devices)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu import obs
@@ -240,10 +258,62 @@ def run_smoke():
     assert server._model_watch.stale, "corrupt publish not flagged"
     g = obs.registry().get("serve.model_stale")
     assert g is not None and g.value == 1.0
+
+    # 4) elastic resize cycle: kill a 4-shard streamed run, resume the
+    # SAME checkpoint at 2 shards (the score re-cut path), verify the
+    # continued trees are bit-equal to the uninterrupted 4-shard run,
+    # and serve through the narrower publish with zero dropped predicts
+    e4 = tempfile.mkdtemp(prefix="lgbm_chaos_e4_")
+    epub = tempfile.mkdtemp(prefix="lgbm_chaos_epub_")
+    ebase = {"objective": "binary", "num_leaves": 8, "max_depth": 3,
+             "verbosity": -1, "tpu_streaming": "true",
+             "tpu_stream_block_rows": 1_024, "tree_learner": "data",
+             "use_quantized_grad": True, "checkpoint_interval": 2}
+    straight4 = lgb.train(dict(ebase, tpu_mesh_shape=4,
+                               checkpoint_dir=e4),
+                          lgb.Dataset(X, label=y), num_boost_round=6)
+    try:
+        lgb.train(dict(ebase, tpu_mesh_shape=4, checkpoint_dir=epub,
+                       tpu_fault_inject="exn:iter=4"),
+                  lgb.Dataset(X, label=y), num_boost_round=6)
+        raise AssertionError("elastic-cycle fault never fired")
+    except lgb.LightGBMError:
+        pass
+    eserver = lgb.train({"objective": "binary", "num_leaves": 8,
+                         "max_depth": 3, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+    eserver.watch_checkpoints(epub, interval=0.0)
+    edropped = 0
+    try:
+        eserver.predict(Xq)     # adopts the dying trainer's publish
+    except Exception:
+        edropped += 1
+    resized = lgb.train(dict(ebase, tpu_mesh_shape=2,
+                             checkpoint_dir=epub),
+                        lgb.Dataset(X, label=y), num_boost_round=6,
+                        resume_from=epub)
+    assert resized.model_to_string() == straight4.model_to_string(), \
+        "elastic resize (4 -> 2 shards) lost bit-equality with the " \
+        "uninterrupted 4-shard run"
+    p_narrow = None
+    for _ in range(3):
+        try:
+            p_narrow = eserver.predict(Xq)
+        except Exception:
+            edropped += 1
+    assert edropped == 0, f"{edropped} predict(s) dropped across the " \
+        f"resize cycle"
+    assert eserver._model_watch.swaps >= 2, \
+        "narrower publish was never adopted"
+    np.testing.assert_allclose(p_narrow, resized.predict(Xq),
+                               rtol=1e-5, atol=1e-6)
+
     print(json.dumps({
-        "chaos_smoke": 1, "secs": round(time.time() - t0, 1),
+        "chaos_smoke": 1, "elastic_smoke": 1,
+        "secs": round(time.time() - t0, 1),
         "resume_bit_exact": True, "swap_compiles": w.compiles,
-        "stale_flagged": True}), flush=True)
+        "stale_flagged": True, "elastic_recut_bit_exact": True,
+        "elastic_dropped_predicts": edropped}), flush=True)
     return 0
 
 
@@ -275,7 +345,7 @@ if __name__ == "__main__":
     except Exception as e:
         import traceback
         traceback.print_exc()
-        print(json.dumps({"chaos_smoke": 0,
+        print(json.dumps({"chaos_smoke": 0, "elastic_smoke": 0,
                           "error": f"{type(e).__name__}: {e}"}),
               flush=True)
         sys.exit(1)

@@ -51,6 +51,8 @@ __all__ = [
     "HIST_PARTITION_MIN_ROWS", "hist_partition_auto",
     "DEVICE_INGEST", "device_ingest_verdict", "forced_engine",
     "SHARDED_PREDICT", "sharded_predict_verdict",
+    "STREAM_RECUT", "stream_recut_verdict",
+    "stream_recut_verdict_params",
 ]
 
 SUPPORTED = "supported"
@@ -375,3 +377,82 @@ def sharded_predict_verdict(engine: str, config=None) -> str:
                                            False)):
         return DEMOTE
     return SHARDED_PREDICT.get(engine, DEMOTE)
+
+
+# can streamed per-(rank, block) score slots be RE-CUT onto a changed
+# shard/block topology on resume (boosting/streaming.py
+# import_train_state)?  The slots themselves are a deterministic
+# function of trees × global rows — reshardable (or recomputable from
+# the pickled trees) exactly, for any numerics. What the verdict
+# guards is the CONTINUED training: bit-equality vs an uninterrupted
+# run at the original cut holds only where per-level histogram
+# accumulation is cut-invariant — integer quantized level sums.
+# Exact-f32 accumulation reassociates when the block/shard cut moves
+# (documented-close, not bit-equal), so that cell is FATAL unless the
+# user opts into the divergence via ``tpu_elastic_recut=true``
+# (docs/robustness.md "Elastic topology").
+STREAM_RECUT: Dict[str, str] = {
+    "quantized": SUPPORTED,
+    "exact_f32": FATAL,       # tpu_elastic_recut=true demotes to a
+    #                           recompute-with-divergence-warning
+}
+
+
+def stream_recut_verdict(config) -> Tuple[str, str]:
+    """(verdict, why) for re-cutting streamed score state onto a
+    layout different from the one the checkpoint was written under.
+    SUPPORTED = re-cut, bit-exact continuation; DEMOTE = re-cut with a
+    documented-divergence warning (the ``tpu_elastic_recut=true``
+    override); FATAL = refuse, ``why`` names the blocking feature, the
+    table cell, and the knob."""
+    knob = str(getattr(config, "tpu_elastic_recut", "auto"))
+    if knob == "false":
+        return FATAL, (
+            "tpu_elastic_recut=false pins the strict PR-13 contract: "
+            "any shard/block layout change on streamed resume is a "
+            "hard error — resume under the original layout, or drop "
+            "the pin")
+    cell = "quantized" if bool(config.use_quantized_grad) \
+        else "exact_f32"
+    if STREAM_RECUT[cell] == SUPPORTED:
+        return SUPPORTED, (
+            "integer quantized level histograms are shard/block-cut-"
+            "invariant, so the re-cut continuation is bit-exact")
+    if knob == "true":
+        return DEMOTE, (
+            "tpu_elastic_recut=true forces the re-cut: exact-f32 "
+            "histogram sums reassociate under the new cut, so the "
+            "continued trees are documented-close to — not bit-equal "
+            "with — an uninterrupted run at the original layout")
+    return FATAL, (
+        "exact-f32 streamed score accumulation (use_quantized_grad "
+        "off) is the blocking feature: per-level histogram sums "
+        "reassociate under a changed shard/block cut, so the resumed "
+        "run would be documented-close rather than bit-equal "
+        "(capability cell capabilities.STREAM_RECUT['exact_f32']). "
+        "Either train with use_quantized_grad=true (cut-invariant "
+        "integer sums — bit-exact elastic resume), force the re-cut "
+        "with tpu_elastic_recut=true (recompute with a divergence "
+        "warning), or resume under the original layout")
+
+
+class _RecutParamsView:
+    """Minimal Config-shaped view over a raw params dict for
+    :func:`stream_recut_verdict` — the launcher's degrade path must
+    predict the verdict BEFORE deciding to resume a narrower gang
+    (a full Config build has process-wide side effects there)."""
+
+    def __init__(self, params: Dict[str, Any]):
+        from .config import coerce_tristate, get_param
+        self.tpu_elastic_recut = coerce_tristate(
+            get_param(params, "tpu_elastic_recut"),
+            "tpu_elastic_recut")
+        self.use_quantized_grad = bool(
+            get_param(params, "use_quantized_grad"))
+
+
+def stream_recut_verdict_params(params: Dict[str, Any]
+                                ) -> Tuple[str, str]:
+    """:func:`stream_recut_verdict` over a raw params dict (alias- and
+    type-resolved through ``config.get_param``)."""
+    return stream_recut_verdict(_RecutParamsView(params))

@@ -260,6 +260,19 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # marker directory for fault fire-once bookkeeping (defaults to
     # checkpoint_dir when unset)
     "tpu_fault_marker": _P("str", ""),
+    # elastic streamed resume (docs/robustness.md "Elastic topology"):
+    # may import_train_state RE-CUT streamed per-(rank, block) score
+    # slots onto a shard/block layout different from the one the
+    # checkpoint was written under?  "auto" re-cuts only where the
+    # continued training stays bit-exact (use_quantized_grad: integer
+    # level sums are cut-invariant) and fatals otherwise; "true"
+    # forces the re-cut on the exact-f32 path too (recompute with a
+    # documented-divergence warning — f32 histogram sums reassociate
+    # under the new cut); "false" pins the strict PR-13 contract
+    # (any layout change on streamed resume is a hard error).
+    # Eligibility is a capability-table verdict
+    # (capabilities.stream_recut_verdict / STREAM_RECUT)
+    "tpu_elastic_recut": _P("str", "auto"),
     # watchdog liveness: when set, the training round loop stamps a
     # per-rank heartbeat FILE (heartbeat.train.rank<r>) under this dir
     # (mtime = liveness; throttled to ~1 Hz). train_distributed sets it
@@ -743,6 +756,8 @@ class Config:
                                                   "tpu_hist_partition")
         self.tpu_serve_shard_trees = coerce_tristate(
             self.tpu_serve_shard_trees, "tpu_serve_shard_trees")
+        self.tpu_elastic_recut = coerce_tristate(self.tpu_elastic_recut,
+                                                 "tpu_elastic_recut")
         setup_compile_cache(self.tpu_compile_cache_dir)
         # observability knobs engage process-wide (enable-only: the 2-3
         # Config objects one train() builds must not flip it back off)

@@ -176,7 +176,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if not hasattr(eng, "import_train_state"):
             log.fatal(f"resume_from is not supported by the "
                       f"{type(eng).__name__} engine")
-        eng.import_train_state(resume_state["engine"])
+        eng_state = resume_state["engine"]
+        ckpt_path = resume_state.get("_checkpoint_path")
+        if isinstance(eng_state, dict) and ckpt_path:
+            # elastic resume (boosting/streaming.py _import_recut): a
+            # topology-changed import may need sibling old ranks'
+            # checkpoint files from the same directory
+            import os as _os
+            eng_state.setdefault("_checkpoint_dir",
+                                 _os.path.dirname(str(ckpt_path)))
+        eng.import_train_state(eng_state)
         bstate = resume_state.get("booster") or {}
         booster.best_iteration = int(bstate.get("best_iteration", -1))
         booster.best_score = {k: dict(v) for k, v in

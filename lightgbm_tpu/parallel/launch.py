@@ -117,6 +117,68 @@ def _stale_heartbeats(hb_dir: Optional[str],
     return sorted(stale)
 
 
+def _clear_rank_snapshots_beyond(rank_dir: Optional[str],
+                                 width: int) -> None:
+    """Remove per-rank metrics snapshots for ranks >= the LIVE gang
+    width before any (re)launch — a gang relaunched narrower (R'=2
+    after R=4) must not merge the previous topology's rank_2/rank_3
+    snapshots into merged.jsonl as if those ranks were still
+    members."""
+    if not rank_dir:
+        return
+    rank_re = re.compile(r"^rank_(\d+)\.jsonl$")
+    try:
+        names = os.listdir(rank_dir)
+    except OSError:
+        return
+    stale = []
+    for name in names:
+        m = rank_re.match(name)
+        if m and int(m.group(1)) >= width:
+            stale.append(name)
+    for name in stale:
+        try:
+            os.remove(os.path.join(rank_dir, name))
+        except OSError:
+            pass
+    if stale:
+        log.warning(f"tpu_metrics_rank_dir {rank_dir} held "
+                    f"{len(stale)} snapshot file(s) for ranks beyond "
+                    f"the live width {width}; cleared before launch")
+
+
+def _gone_ranks(gone_dirs: List[str], hb_dir: Optional[str],
+                width: int, early_dead, hb_strikes: Dict[int, int],
+                strikes_needed: int = 2) -> List[int]:
+    """Ranks whose HOST is gone, from two signals: explicit
+    ``.host_gone.rank<r>`` markers (the ``resize`` chaos fault, or an
+    operator touch-file), and the spawn-failure heuristic — a rank
+    that died on its own without EVER stamping a heartbeat this
+    attempt collects a strike; ``strikes_needed`` consecutive strikes
+    read as "that machine cannot even start a worker". Mutates
+    ``hb_strikes`` (stamped ranks reset)."""
+    from ..recovery.faults import host_gone_ranks
+    gone = set()
+    for d in gone_dirs:
+        gone.update(host_gone_ranks(d))
+    if hb_dir:
+        # "consecutive" means exactly that: ANY rank that stamped a
+        # heartbeat this attempt proved its host can start a worker —
+        # its strike count resets even when the gang failed for an
+        # unrelated reason and the rank never re-entered early_dead
+        for r in list(hb_strikes):
+            if os.path.exists(
+                    os.path.join(hb_dir, f"heartbeat.train.rank{r}")):
+                hb_strikes.pop(r, None)
+        for r, _code in early_dead:
+            if not os.path.exists(
+                    os.path.join(hb_dir, f"heartbeat.train.rank{r}")):
+                hb_strikes[r] = hb_strikes.get(r, 0) + 1
+        gone.update(r for r, s in hb_strikes.items()
+                    if s >= strikes_needed)
+    return sorted(r for r in gone if 0 <= r < width)
+
+
 @dataclass
 class ShardSpec:
     """What ``data_fn`` returns: this process's row shard."""
@@ -284,9 +346,12 @@ def _gang_once(params: Dict, data_fn, n_processes: int,
                hb_dir: Optional[str] = None,
                hb_timeout: float = 0.0):
     """One fork/join pass over a fresh worker gang on a fresh port.
-    Returns the ("ok", model_str) / ("err", payload) queue result, or
-    None when the gang died or timed out without reporting (plus the
-    dead rank/exitcode list for the error message).
+    Returns ``(result, dead, early_dead)``: the ("ok", model_str) /
+    ("err", payload) queue result or None when the gang died or timed
+    out without reporting, the post-teardown dead rank/exitcode list
+    for the error message, and ``early_dead`` — the ranks that died ON
+    THEIR OWN before teardown (a teardown-terminated survivor must not
+    feed the degrade heuristic's spawn-failure strikes).
 
     ``hb_dir``/``hb_timeout``: the heartbeat watchdog — workers stamp
     per-rank heartbeat files each round (engine.train via
@@ -314,6 +379,7 @@ def _gang_once(params: Dict, data_fn, n_processes: int,
     import queue as _queue
     import time as _time
     result = None
+    early_dead = []
     deadline = _time.monotonic() + timeout
     while result is None and _time.monotonic() < deadline:
         try:
@@ -322,6 +388,7 @@ def _gang_once(params: Dict, data_fn, n_processes: int,
             dead = [(i, p.exitcode) for i, p in enumerate(procs)
                     if not p.is_alive() and p.exitcode not in (0, None)]
             if dead:
+                early_dead = dead
                 break
             stale = _stale_heartbeats(hb_dir, hb_timeout)
             if stale:
@@ -383,7 +450,13 @@ def _gang_once(params: Dict, data_fn, n_processes: int,
                       f"died while reporting")
     dead = [(i, p.exitcode) for i, p in enumerate(procs)
             if p.exitcode not in (0, None)]
-    return result, dead
+    if not early_dead:
+        # a worker can die between the last poll and teardown; ranks
+        # the TEARDOWN terminated show SIGTERM/SIGKILL exit codes and
+        # are excluded (they were alive — not a spawn failure)
+        early_dead = [(i, c) for i, c in dead
+                      if c not in (-15, -9)] if not clean else []
+    return result, dead, early_dead
 
 
 def train_distributed(params: Dict,
@@ -552,17 +625,99 @@ def train_distributed(params: Dict,
     _backoff_rng = _random.Random()
     _backoff_prev = 0.0
     attempt = 0           # restart attempts consumed (not bind retries)
+
+    # elastic topology (docs/robustness.md "Elastic topology"): the
+    # gang's LIVE width. A rank whose HOST is permanently gone — a
+    # `.host_gone.rank<r>` marker from the resize chaos fault or an
+    # operator, or repeated deaths without ever stamping a heartbeat —
+    # narrows the gang instead of burning max_restarts relaunching at
+    # full strength; the relaunched workers re-shard the rows over the
+    # new width and the streamed resume path re-cuts the checkpoint
+    # onto the new topology.
+    live_width = int(n_processes)
+    if live_width < 1:
+        raise LightGBMError(f"n_processes must be >= 1, got "
+                            f"{n_processes}")
+    gone_dirs = [d for d in dict.fromkeys(
+        (fault_marker_dir, ckpt_dir, hb_dir)) if d]
+    from ..recovery.faults import clear_host_gone_markers
+    if resume_from is None:
+        # fresh run: yesterday's host loss must not shrink today's gang
+        for d in gone_dirs:
+            clear_host_gone_markers(d)
+    hb_strikes: Dict[int, int] = {}
+
+    def _apply_degrade(early_dead) -> bool:
+        """Consume host-gone evidence; True = the gang narrowed and
+        the caller should relaunch WITHOUT burning a restart attempt."""
+        nonlocal live_width, resume_from
+        gone = _gone_ranks(gone_dirs,
+                           hb_dir if hb_timeout > 0 else None,
+                           live_width, early_dead, hb_strikes)
+        if not gone:
+            return False
+        if len(gone) >= live_width:
+            raise LightGBMError(
+                f"every live rank's host is gone ({gone}); nothing "
+                f"left to degrade the gang to")
+        from .. import obs
+        # forced: degrades fire in the driver, before any worker
+        # Config can flip metrics on — like the restart counters
+        obs.inc("watchdog.degrades", len(gone), force=True)
+        for d in gone_dirs:
+            clear_host_gone_markers(d, ranks=gone)
+        live_width -= len(gone)
+        hb_strikes.clear()
+        resume_from = (ckpt_dir if ckpt_dir
+                       and has_resumable_checkpoint(ckpt_dir)
+                       else None)
+        if resume_from:
+            # a FORCED-streaming job whose re-cut the capability table
+            # refuses (exact f32 without the tpu_elastic_recut opt-in)
+            # would fatal on EVERY narrower relaunch and burn
+            # max_restarts — exactly what degrade exists to avoid.
+            # Predict the verdict and restart from scratch instead.
+            from .. import capabilities
+            if capabilities.forced_engine(params) == "streaming":
+                v, why = capabilities.stream_recut_verdict_params(
+                    params)
+                if v == capabilities.FATAL:
+                    log.warning(
+                        f"degrade-and-continue: the streamed "
+                        f"checkpoint cannot be re-cut onto the "
+                        f"narrower topology ({why}); restarting from "
+                        f"scratch at the reduced width instead of "
+                        f"burning restarts on a refused resume")
+                    resume_from = None
+        log.warning(
+            f"degrade-and-continue: host(s) of rank(s) {gone} are "
+            f"permanently gone; relaunching the gang at width "
+            f"{live_width} "
+            + (f"resuming from the newest topology-complete "
+               f"checkpoint in {resume_from}" if resume_from else
+               "with no resumable checkpoint — restarting the run "
+               "from scratch at the reduced width"))
+        return True
+
+    # a marker already on disk at entry (e.g. resume="auto" after the
+    # driver itself died mid-incident) narrows the FIRST gang too —
+    # "missing host at gang start" must not cost a full-width attempt
+    _apply_degrade([])
     try:
         os.environ[_RELAUNCH_ENV] = "1"
         while True:
+            # stale-rank snapshot hygiene on EVERY (re)launch: a
+            # narrower relaunch must not merge the wider topology's
+            # rank_<r>.jsonl as live gang members
+            _clear_rank_snapshots_beyond(rank_dir, live_width)
             result = None
             # the coordinator port race (_free_port -> jax.distributed
             # bind) loses when another process grabs the probed port
             # first; a bind failure retries on a fresh port WITHOUT
             # consuming a restart attempt
             for bind_attempt in range(3):
-                result, dead = _gang_once(
-                    params, data_fn, n_processes, num_boost_round,
+                result, dead, early_dead = _gang_once(
+                    params, data_fn, live_width, num_boost_round,
                     platform, categorical_feature, timeout, resume_from,
                     hb_dir=hb_dir if hb_timeout > 0 else None,
                     hb_timeout=hb_timeout)
@@ -592,6 +747,8 @@ def train_distributed(params: Dict,
                        if dead else
                        "(workers timed out before rank 0 reported; "
                        "re-run with verbosity>=1 for worker logs)"))
+            if _apply_degrade(early_dead):
+                continue      # narrower relaunch; no attempt consumed
             attempt += 1
             if attempt > max_restarts:
                 raise failure
@@ -649,7 +806,10 @@ def train_distributed(params: Dict,
 
     import lightgbm_tpu as lgb
     bst = lgb.Booster(model_str=bst_str)
-    log.info(f"distributed training done: {n_processes} processes, "
-             f"{bst.num_trees()} trees collected from rank 0"
+    log.info(f"distributed training done: {live_width} processes"
+             + (f" (degraded from {n_processes} — "
+                f"{n_processes - live_width} host(s) lost)"
+                if live_width != n_processes else "")
+             + f", {bst.num_trees()} trees collected from rank 0"
              + (f" ({attempt} restart(s))" if attempt else ""))
     return bst

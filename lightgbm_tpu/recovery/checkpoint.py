@@ -46,7 +46,7 @@ CHECKPOINT_VERSION = 1
 _FILE_RE = re.compile(r"^ckpt_(\d{8})\.rank(\d+)\.ckpt$")
 
 __all__ = ["CheckpointError", "CheckpointManager", "load_for_resume",
-           "MAGIC", "CHECKPOINT_VERSION"]
+           "latest_complete_iteration", "MAGIC", "CHECKPOINT_VERSION"]
 
 
 class CheckpointError(LightGBMError):
@@ -278,6 +278,46 @@ class CheckpointManager:
         return None
 
 
+def latest_complete_iteration(
+        directory: Union[str, os.PathLike]) -> Optional[int]:
+    """Newest iteration at which EVERY rank file of the writing gang
+    verifies — "valid under the new topology": the rank files present
+    form a contiguous ``0..P-1`` set (P = however wide the WRITING
+    gang was; the reader's width is irrelevant) and each passes the
+    checksum scan. The elastic resume agreement uses this for ranks
+    that have NO own-rank files (a gang relaunched wider than the
+    writer) so elastic growth does not force every rank back to
+    scratch. Caveat: the writing width is inferred from the files
+    PRESENT, so an iteration whose highest-numbered rank file was
+    never written still looks complete — load_for_resume's min fold
+    over the old ranks' own-latest values clamps that overshoot (and
+    rows a lost file leaves uncovered replay bit-exactly from the
+    trees regardless). Returns None when no iteration is complete."""
+    directory = str(directory)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    by_iter: Dict[int, List[int]] = {}
+    for name in names:
+        m = _FILE_RE.match(name)
+        if m:
+            by_iter.setdefault(int(m.group(1)), []).append(
+                int(m.group(2)))
+    mgr = CheckpointManager(directory, rank=0)
+    for it in sorted(by_iter, reverse=True):
+        ranks = sorted(set(by_iter[it]))
+        if ranks != list(range(len(ranks))):
+            continue        # a gap means some old rank's file is gone
+        try:
+            for r in ranks:
+                mgr.load_file(mgr.path(it, rank=r), verify_only=True)
+        except CheckpointError:
+            continue
+        return it
+    return None
+
+
 def clear_checkpoint_dir(directory: Union[str, os.PathLike]) -> int:
     """Remove EVERY rank's checkpoint files and latest pointers from
     ``directory`` (driver-side fresh-run hygiene — worker-side clearing
@@ -343,7 +383,22 @@ def load_for_resume(path: Union[str, os.PathLike],
             return np.asarray(
                 multihost_utils.process_allgather(mine)).reshape(-1)
 
+        # topology-aware agreement: ranks WITH their own files keep
+        # the proven min-over-own-latest semantics (correct on shared
+        # AND per-host checkpoint dirs, and the min already walks past
+        # an iteration a crashed trailing rank never finished
+        # writing); a rank with NO own files — a gang relaunched
+        # WIDER than the writer — contributes the newest
+        # topology-complete iteration from the (necessarily shared)
+        # directory instead of -1, so elastic growth no longer forces
+        # every rank back to scratch. The min fold also clamps any
+        # overshoot in the completeness scan's width inference (it
+        # cannot see a trailing rank file that was never written; an
+        # old rank's own-latest can, and wins the min).
         latest = mgr.latest_valid_iteration()
+        if latest is None:
+            comp = latest_complete_iteration(path)
+            latest = comp if comp is not None else None
         gathered = _gather(latest if latest is not None else -1)
         target = int(gathered.min())
         if target < 0:
@@ -362,7 +417,23 @@ def load_for_resume(path: Union[str, os.PathLike],
         # succeed on EVERY rank or no rank may resume, else the gang
         # desyncs (and a crash here would repeat on every restart)
         try:
-            state = mgr.load(iteration=target)
+            try:
+                state = mgr.load(iteration=target)
+            except CheckpointError:
+                if mgr.rank == 0:
+                    raise
+                # a gang WIDER than the writer: ranks beyond the old
+                # width have no own-rank file — adopt rank 0's state
+                # (trees/RNG are rank-identical; the streaming
+                # engine's elastic import re-cuts the scores and
+                # reads sibling rank files itself)
+                log.warning(
+                    f"resume: rank {mgr.rank} has no valid own "
+                    f"checkpoint at the agreed iteration {target}; "
+                    f"adopting rank 0's state for an elastic re-cut")
+                state = CheckpointManager(path, keep_n=keep_n,
+                                          rank=0).load(
+                                              iteration=target)
             ok = 1
         except CheckpointError as e:
             log.warning(f"resume: cannot load the gang-agreed "

@@ -19,16 +19,26 @@ Spec syntax: one or more ``;``-separated specs, each
   past,
 * ``port``    — raise an error shaped like the coordinator bind race
   ("address already in use"), so relaunch paths exercise their
-  fresh-port bind retry deterministically.
+  fresh-port bind retry deterministically,
+* ``resize``  — a PERMANENT host loss (autoscale-down, a machine
+  pulled from the fleet): SIGKILL the ranks named by ``ranks`` AND
+  write a ``.host_gone.rank<r>`` marker per named rank, which the
+  launcher's degrade-and-continue path reads as "this host is not
+  coming back — relaunch the gang narrower instead of burning
+  ``max_restarts`` retrying at full strength"
+  (docs/robustness.md "Elastic topology").
 
 Keys: ``iter`` (required; 0-based boosting iteration — the fault fires
 BEFORE that iteration runs; ``slow`` keeps firing every iteration >=
 ``iter``), ``rank`` (optional ``jax`` process index; default: every
 process), ``ms`` (``slow``/``hang``: delay per fire / max wedge time,
 default 200 / wedge-forever), ``target`` and ``nbytes`` (``corrupt``:
-what to damage and how many bytes to flip, default ``ckpt`` / 8).
+what to damage and how many bytes to flip, default ``ckpt`` / 8),
+``ranks`` (``resize``, required: ``+``-separated rank list whose hosts
+go away, e.g. ``ranks=1`` or ``ranks=2+3``).
 Examples: ``"kill:rank=1,iter=10"``, ``"hang:rank=0,iter=6"``,
-``"corrupt:iter=8,target=both"``, ``"slow:iter=3,ms=250;exn:iter=9"``.
+``"corrupt:iter=8,target=both"``, ``"slow:iter=3,ms=250;exn:iter=9"``,
+``"resize:iter=4,ranks=1"``.
 
 Determinism: every random choice a fault makes (which bytes ``corrupt``
 flips) is drawn from a PRNG seeded by the spec text itself
@@ -37,7 +47,7 @@ spec alone.
 
 Fire-once semantics: when a marker directory is available (explicit
 ``tpu_fault_marker``, else ``checkpoint_dir``), firing a TERMINAL or
-DAMAGING fault (kill/exn/hang/corrupt/port) writes a marker file keyed
+DAMAGING fault (kill/exn/hang/corrupt/port/resize) writes a marker file keyed
 by (spec, rank); a restarted process that replays the same iteration
 skips the fault instead of dying forever in a restart loop. ``slow``
 never writes markers (it is not terminal and must keep firing to model
@@ -63,9 +73,11 @@ from ..utils.log import LightGBMError
 
 __all__ = ["FaultPlan", "parse_fault_spec", "parse_fault_specs",
            "fault_injection_callback", "clear_fault_markers",
+           "host_gone_ranks", "clear_host_gone_markers",
            "spec_seed", "FAULT_KINDS"]
 
-FAULT_KINDS = ("kill", "exn", "hang", "slow", "corrupt", "port")
+FAULT_KINDS = ("kill", "exn", "hang", "slow", "corrupt", "port",
+               "resize")
 
 # keys each kind accepts beyond the required ``iter`` (+ optional
 # ``rank``); unknown keys are a spec typo the user must hear about
@@ -76,7 +88,14 @@ _KIND_KEYS: Dict[str, tuple] = {
     "slow": ("ms",),
     "corrupt": ("target", "nbytes"),
     "port": (),
+    "resize": ("ranks",),
 }
+
+# what a ``resize`` fault leaves behind for the launcher: one
+# ``.host_gone.rank<r>`` marker per permanently-lost rank. Consumed
+# (deleted) by the degrade-and-continue path when it narrows the gang;
+# cleared by fresh (non-resuming) launcher runs.
+_HOST_GONE_PREFIX = ".host_gone.rank"
 
 # message shaped to match recovery/restart.py's _BIND_TOKENS so the
 # launcher's bind-retry path (fresh port, no restart attempt consumed)
@@ -130,6 +149,51 @@ def clear_fault_markers(directory, rank: Optional[int] = None) -> int:
     return removed
 
 
+def host_gone_ranks(directory) -> List[int]:
+    """Ranks with a ``.host_gone.rank<r>`` marker in ``directory`` —
+    hosts the chaos harness (or an operator touch-file) declared
+    permanently lost. The launcher reads these to degrade-and-continue
+    instead of relaunching at full width."""
+    directory = str(directory or "")
+    if not directory:
+        return []
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith(_HOST_GONE_PREFIX):
+            tail = name[len(_HOST_GONE_PREFIX):]
+            if tail.isdigit():
+                out.append(int(tail))
+    return sorted(set(out))
+
+
+def clear_host_gone_markers(directory,
+                            ranks: Optional[List[int]] = None) -> int:
+    """Remove host-gone markers from ``directory`` — every rank's when
+    ``ranks`` is None (fresh-run hygiene), the named ranks' when given
+    (the degrade path CONSUMES the markers it acted on, so a later
+    unrelated failure cannot re-apply yesterday's loss). Returns the
+    count removed."""
+    directory = str(directory or "")
+    if not directory:
+        return 0
+    wanted = None if ranks is None else {int(r) for r in ranks}
+    removed = 0
+    for r in host_gone_ranks(directory):
+        if wanted is not None and r not in wanted:
+            continue
+        try:
+            os.unlink(os.path.join(directory,
+                                   f"{_HOST_GONE_PREFIX}{r}"))
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
 @dataclass
 class FaultPlan:
     kind: str                   # one of FAULT_KINDS
@@ -143,6 +207,7 @@ class FaultPlan:
     target: str = "ckpt"        # corrupt: ckpt | latest | both
     nbytes: int = 8             # corrupt: bytes flipped per file
     ckpt_dir: str = ""          # corrupt: where checkpoints live
+    ranks: tuple = ()           # resize: ranks whose hosts go away
 
     def marker_path(self, rank: int) -> str:
         h = hashlib.sha1(self.spec.encode("utf-8")).hexdigest()[:10]
@@ -177,6 +242,38 @@ class FaultPlan:
 
     def _fire_slow(self, rank: int) -> None:
         time.sleep(max(self.ms, 1) / 1000.0)
+
+    def _fire_resize(self, rank: int) -> None:
+        """Permanent host loss: every firing process writes the
+        ``.host_gone.rank<r>`` markers (idempotent file creates — the
+        launcher must see them even when a listed rank is too wedged
+        to write its own), then the LISTED ranks SIGKILL themselves.
+        Survivors return to training and die in the gang teardown —
+        exactly the shape of a machine vanishing mid-collective. No
+        random draws, so the spec text alone replays it."""
+        d = self.marker_dir or self.ckpt_dir
+        if d:
+            os.makedirs(d, exist_ok=True)
+            for q in self.ranks:
+                try:
+                    with open(os.path.join(
+                            d, f"{_HOST_GONE_PREFIX}{int(q)}"),
+                            "w") as f:
+                        f.write(self.spec + "\n")
+                except OSError as e:
+                    log.warning(f"tpu_fault_inject: cannot write "
+                                f"host-gone marker for rank {q}: {e}")
+        else:
+            log.warning(
+                f"tpu_fault_inject: resize fault has no marker/"
+                f"checkpoint dir to signal the launcher through "
+                f"({self.spec!r}); the ranks still die but the gang "
+                f"can only restart at full width")
+        if rank in self.ranks:
+            log.warning(f"tpu_fault_inject: resize — host of rank "
+                        f"{rank} is gone before iteration "
+                        f"{self.iteration} ({self.spec!r})")
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def _fire_corrupt(self, rank: int) -> None:
         """Flip ``nbytes`` PAYLOAD bytes of the newest checkpoint (and/
@@ -264,6 +361,9 @@ class FaultPlan:
         if self.kind == "corrupt":
             self._fire_corrupt(rank)
             return                       # damage done; training goes on
+        if self.kind == "resize":
+            self._fire_resize(rank)
+            return                       # survivors keep training
         if self.kind == "port":
             raise LightGBMError(
                 _PORT_MSG.format(it=self.iteration, spec=self.spec))
@@ -299,6 +399,13 @@ def parse_fault_spec(spec: str, marker_dir: str = "",
                 log.fatal(f"tpu_fault_inject: target must be ckpt, "
                           f"latest or both (got {v!r} in {spec!r})")
             fields[k] = v
+        elif k == "ranks":
+            parts = [p.strip() for p in v.split("+")]
+            if not parts or not all(p.isdigit() for p in parts):
+                log.fatal(f"tpu_fault_inject: cannot parse {tok!r} in "
+                          f"{spec!r} (ranks=<r> or ranks=<r>+<r>+... "
+                          f"expected)")
+            fields[k] = tuple(sorted({int(p) for p in parts}))
         else:
             if not v.lstrip("-").isdigit():
                 log.fatal(f"tpu_fault_inject: cannot parse {tok!r} in "
@@ -306,6 +413,10 @@ def parse_fault_spec(spec: str, marker_dir: str = "",
             fields[k] = int(v)
     if "iter" not in fields:
         log.fatal(f"tpu_fault_inject: {spec!r} needs an iter=<n> field")
+    if kind == "resize" and not fields.get("ranks"):
+        log.fatal(f"tpu_fault_inject: a resize fault needs "
+                  f"ranks=<r>[+<r>...] naming the hosts that go away "
+                  f"({spec!r})")
     if kind == "corrupt" and "rank" not in fields:
         # corrupt damages rank 0's files; with rank unset EVERY rank
         # would run the same spec-seeded XOR flips on the same bytes —
@@ -320,7 +431,8 @@ def parse_fault_spec(spec: str, marker_dir: str = "",
                                        200 if kind == "slow" else 0)),
                      target=str(fields.get("target", "ckpt")),
                      nbytes=int(fields.get("nbytes", 8)),
-                     ckpt_dir=str(ckpt_dir or ""))
+                     ckpt_dir=str(ckpt_dir or ""),
+                     ranks=tuple(fields.get("ranks", ())))
 
 
 def parse_fault_specs(spec: str, marker_dir: str = "",

@@ -64,10 +64,33 @@ python -c "import __graft_entry__ as g; g.dryrun_multichip(2, only=('streaming',
 # hot-swap in one process — streamed resume must stay BIT-EQUAL to the
 # uninterrupted run, the swap must compile nothing, and a corrupted
 # publish must degrade gracefully; its status rides the obs line so
-# scripts/obs_trend.py fails absolutely on chaos_smoke=0
+# scripts/obs_trend.py fails absolutely on chaos_smoke=0. The smoke
+# also runs the ELASTIC RESIZE cycle (kill -> resume narrower ->
+# verify bit-equality + zero dropped predicts; docs/robustness.md
+# "Elastic topology") and reports it as elastic_smoke in its final
+# JSON record — parsed below onto the obs line, enforced absolutely
+# by obs_trend.py and by exit 8 here
 CHAOS_SMOKE=1
+CHAOS_JSON=/tmp/_check_chaos_smoke.log
+rm -f "$CHAOS_JSON"
 JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
-python benchmarks/chaos_bench.py --smoke || CHAOS_SMOKE=0
+python benchmarks/chaos_bench.py --smoke 2>&1 | tee "$CHAOS_JSON" \
+  || CHAOS_SMOKE=0
+ELASTIC_SMOKE=$(python - "$CHAOS_JSON" <<'PY'
+import json, sys
+v = 0
+try:
+    for ln in open(sys.argv[1]):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            d = json.loads(ln)
+            if "elastic_smoke" in d:
+                v = int(d["elastic_smoke"])
+except Exception:
+    v = 0
+print(v)
+PY
+)
 
 # serving smoke (docs/serving.md): N concurrent clients through the
 # micro-batching service with a 1-model LRU and a mid-traffic hot-swap
@@ -98,10 +121,11 @@ LINT_FINDINGS=$(cat "$LINT_COUNT_FILE" 2>/dev/null || echo -1)
 # dots/seconds from this run plus compile count and peak-HBM estimate
 # read back from the snapshot. A malformed dump FAILS the gate — a
 # check that silently skips its own telemetry is how telemetry rots.
-python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" "$SERVE_JSON" <<'PY' >> scripts/check_timings.log
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" "$SERVE_JSON" "$ELASTIC_SMOKE" <<'PY' >> scripts/check_timings.log
 import json, sys, time
 path, mode, dots, secs, rev, stream_ok, chaos_ok, lint, serve_ok = sys.argv[1:10]
 serve_json = sys.argv[10] if len(sys.argv) > 10 else ""
+elastic_ok = sys.argv[11] if len(sys.argv) > 11 else "0"
 try:
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     snap = json.loads(lines[-1])
@@ -153,6 +177,9 @@ print("obs " + json.dumps({
     "stream_dryrun": int(stream_ok),
     # kill + resume + hot-swap loop (benchmarks/chaos_bench.py --smoke)
     "chaos_smoke": int(chaos_ok),
+    # elastic resize cycle riding the same smoke: kill -> resume
+    # NARROWER -> bit-equality + zero dropped predicts
+    "elastic_smoke": int(elastic_ok),
     # concurrent serving: coalesce + evict + swap under load with zero
     # drops and zero warm compiles (benchmarks/serve_bench.py --smoke)
     "serve_smoke": int(serve_ok),
@@ -173,6 +200,11 @@ fi
 if [[ "$CHAOS_SMOKE" != 1 ]]; then
   echo "check.sh: chaos smoke FAILED (kill+resume+swap; status logged)"
   exit 5
+fi
+if [[ "$ELASTIC_SMOKE" != 1 ]]; then
+  echo "check.sh: elastic smoke FAILED (kill+resume-narrower re-cut;" \
+       "status logged)"
+  exit 8
 fi
 if [[ "$LINT_FINDINGS" != 0 ]]; then
   echo "check.sh: static analysis FAILED ($LINT_FINDINGS finding(s);" \

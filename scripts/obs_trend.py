@@ -34,6 +34,11 @@ regressed past its threshold —
 - ``chaos_smoke`` == 0 in the NEWEST run (absolute, like
   stream_dryrun): the kill + resume + hot-swap chaos smoke check.sh
   runs lost bit-equality, dropped a request, or crashed;
+- ``elastic_smoke`` == 0 in the NEWEST run (absolute, like
+  chaos_smoke): the elastic resize cycle riding the same smoke
+  (kill -> resume the gang NARROWER -> topology re-cut;
+  docs/robustness.md "Elastic topology") lost bit-equality with the
+  uninterrupted full-width run, dropped a predict, or crashed;
 - ``serve_smoke`` == 0 in the NEWEST run (absolute, like chaos_smoke):
   the concurrent serving smoke (``benchmarks/serve_bench.py --smoke``
   — coalesce + LRU-evict + mid-traffic hot-swap under load) dropped a
@@ -156,6 +161,16 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
             "chaos smoke FAILED (chaos_smoke=0): kill + resume + "
             "hot-swap lost bit-equality or crashed "
             "(benchmarks/chaos_bench.py --smoke)")
+    # elastic resume is absolute too: a resize cycle that resumed the
+    # gang narrower and lost bit-equality (or dropped a predict) is a
+    # broken topology re-cut NOW, whatever the trailing median says
+    if _num(newest, "elastic_smoke") == 0.0:
+        failures.append(
+            "elastic smoke FAILED (elastic_smoke=0): the resize cycle "
+            "(kill -> resume narrower -> topology re-cut) lost "
+            "bit-equality, dropped a predict, or crashed "
+            "(benchmarks/chaos_bench.py --smoke; docs/robustness.md "
+            "'Elastic topology')")
     # the serving smoke is absolute the same way: a dropped request or
     # a warm-path compile under coalesce + evict + swap load is broken
     # NOW, whatever the trailing median says
