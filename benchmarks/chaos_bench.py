@@ -195,10 +195,17 @@ def run_smoke():
        server with zero dropped predicts — reported as
        ``elastic_smoke`` in the final record (scripts/check.sh puts
        it on the obs line; scripts/obs_trend.py fails absolutely on
-       ``elastic_smoke=0``).
+       ``elastic_smoke=0``);
+    5. a SERVING-FLEET kill/join cycle (docs/serving.md "Fleet
+       deployment"): 3 replicas behind the elastic router, one
+       SIGKILLed mid-load (zero dropped requests, relaunch admitted
+       only after /readyz), a second killed under a host-gone marker
+       (degrade to 2, still zero drops) — reported as
+       ``fleet_smoke`` (check.sh exit 9; obs_trend absolute pin).
 
     (The true-SIGKILL + watchdog variants live in tests/test_chaos.py
-    gang tests; this smoke stays in-process for speed.)
+    gang tests; this smoke stays in-process for speed — except the
+    fleet cycle, whose replicas are real spawned processes.)
     """
     import os
     import tempfile
@@ -308,12 +315,67 @@ def run_smoke():
     np.testing.assert_allclose(p_narrow, resized.predict(Xq),
                                rtol=1e-5, atol=1e-6)
 
+    # 5) serving-fleet kill/join cycle (docs/serving.md "Fleet
+    # deployment"): 3 replica processes behind the elastic router, one
+    # SIGKILLed mid-load — its in-flight work re-dispatches to
+    # siblings (ZERO dropped requests), the slot relaunches and is
+    # admitted only after /readyz — then a second kill under a
+    # host-gone marker degrades the fleet to 2, still zero drops.
+    # Reported as ``fleet_smoke`` (check.sh exit 9; obs_trend fails
+    # absolutely on fleet_smoke=0)
+    from lightgbm_tpu.serve import (FleetRouter, FleetSupervisor,
+                                    ReplicaModel)
+    fspec = [ReplicaModel(model_id="m",
+                          model_str=straight.model_to_string(),
+                          warmup_row=X[0])]
+    fsup = FleetSupervisor(
+        {"tpu_serve_max_batch_rows": 128,
+         "tpu_serve_batch_budget_ms": 2.0},
+        fspec, 3, heartbeat_timeout=8.0, max_restarts=2)
+    fdropped = 0
+    fref = straight.predict(Xq[:8])
+    fsup.start()
+    frouter = None
+    try:
+        assert fsup.wait_ready(3, timeout=180) == 3, \
+            "fleet never turned ready"
+        frouter = FleetRouter(fsup, request_timeout_s=120.0)
+        futs = [frouter.submit("m", Xq[:8]) for _ in range(60)]
+        fsup.kill_replica(0)                 # crash -> relaunch path
+        futs += [frouter.submit("m", Xq[:8]) for _ in range(60)]
+        fsup.kill_replica(1, host_gone=True)  # host gone -> degrade
+        for f in futs:
+            try:
+                np.testing.assert_allclose(f.result(timeout=120),
+                                           fref, rtol=1e-5, atol=1e-6)
+            except Exception:
+                fdropped += 1
+        assert fdropped == 0, f"{fdropped} request(s) dropped " \
+            f"across the fleet kill cycle"
+        fdeadline = time.time() + 120
+        while fsup.live_count() < 2 and time.time() < fdeadline:
+            time.sleep(0.2)
+        assert fsup.live_count() == 2 and fsup.relaunches >= 1, \
+            "SIGKILLed replica never rejoined the fleet"
+        assert fsup.degrades == 1 and fsup.handles[1].retired, \
+            "host-gone slot did not degrade to N-1"
+        np.testing.assert_allclose(
+            frouter.predict("m", Xq[:8], timeout=60), fref,
+            rtol=1e-5, atol=1e-6)
+    finally:
+        if frouter is not None:
+            frouter.close()
+        fsup.stop()
+
     print(json.dumps({
-        "chaos_smoke": 1, "elastic_smoke": 1,
+        "chaos_smoke": 1, "elastic_smoke": 1, "fleet_smoke": 1,
         "secs": round(time.time() - t0, 1),
         "resume_bit_exact": True, "swap_compiles": w.compiles,
         "stale_flagged": True, "elastic_recut_bit_exact": True,
-        "elastic_dropped_predicts": edropped}), flush=True)
+        "elastic_dropped_predicts": edropped,
+        "fleet_dropped_requests": fdropped,
+        "fleet_relaunches": fsup.relaunches,
+        "fleet_degrades": fsup.degrades}), flush=True)
     return 0
 
 
@@ -346,6 +408,7 @@ if __name__ == "__main__":
         import traceback
         traceback.print_exc()
         print(json.dumps({"chaos_smoke": 0, "elastic_smoke": 0,
+                          "fleet_smoke": 0,
                           "error": f"{type(e).__name__}: {e}"}),
               flush=True)
         sys.exit(1)

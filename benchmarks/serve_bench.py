@@ -20,6 +20,16 @@ Run:
     (queue-wait / coalesce / checkout / dispatch / postprocess p50+p99
     from the SLO windows, slo.device_share, flush-cause counts) and a
     Chrome trace of the run is exported for Perfetto
+  python benchmarks/serve_bench.py --fleet 3            # replicated:
+    3 PredictService replica processes behind the elastic FleetRouter
+    (docs/serving.md "Fleet deployment") — aggregate RPS + POOLED
+    p50/p99 across the fleet; run at --fleet 1/2/3 for the scaling
+    curve
+  python benchmarks/serve_bench.py --fleet 3 --kill-cycle
+    # failover drill under sustained load: SIGKILL one replica
+    # (drain -> relaunch -> /readyz-gated rejoin), then a host-gone
+    # kill (degrade to N-1); exit 0 iff ZERO requests dropped, both
+    # cycles complete, and the pooled p99 holds --p99-target-ms
   python benchmarks/serve_bench.py --smoke              # CI gate:
     sub-minute — concurrent clients, one LRU eviction, one mid-traffic
     hot-swap, tracing flipped ON mid-traffic; exit 0 iff zero requests
@@ -165,6 +175,144 @@ def _trace_decomposition(evs):
 # ---------------------------------------------------------------------------
 # full load run
 # ---------------------------------------------------------------------------
+def run_fleet(args):
+    """Fleet load (docs/serving.md "Fleet deployment"): the same
+    client pool driven through ``FleetRouter`` over ``--fleet N``
+    replica processes. Reports AGGREGATE RPS plus POOLED p50/p99 —
+    one latency pool across every replica, per the re-anchor note
+    (pooled medians, not windowed RPS: scheduler noise makes
+    windowed numbers lie by ±5-10%). ``--kill-cycle`` additionally
+    SIGKILLs one replica mid-load (relaunch cycle: the slot must
+    rejoin through /readyz) and then kills another under a host-gone
+    marker (degrade cycle: the fleet must retire it and keep
+    serving) — exit nonzero unless BOTH cycles complete with ZERO
+    dropped requests and the pooled p99 holds ``--p99-target-ms``."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.serve import (FleetRouter, FleetSupervisor,
+                                    ReplicaModel)
+    obs.enable(metrics=True)
+    if args.kill_cycle and args.fleet < 2:
+        print(json.dumps({"error": "--kill-cycle needs --fleet >= 2 "
+                          "(one replica to kill, one to survive)"}),
+              flush=True)
+        return 2
+    X, y = _data(args.rows)
+    specs = []
+    model_ids = []
+    for m in range(args.models):
+        bst = _train(X, y, args.rounds, args.leaves, seed=m)
+        mid = f"tenant{m}"
+        specs.append(ReplicaModel(model_id=mid,
+                                  model_str=bst.model_to_string(),
+                                  warmup_row=X[0]))
+        model_ids.append(mid)
+    sup = FleetSupervisor(
+        {"tpu_serve_batch_budget_ms": args.budget_ms,
+         "tpu_serve_max_batch_rows": args.max_batch_rows,
+         "tpu_serve_cache_models": args.cache_models,
+         "tpu_serve_shard_trees": args.shard_trees},
+        specs, args.fleet, max_restarts=2, heartbeat_timeout=10.0)
+    t_up = time.time()
+    sup.start()
+    router = None
+    kill_cycle = {}
+    try:
+        ready = sup.wait_ready(args.fleet, timeout=240.0)
+        if ready < args.fleet:
+            print(json.dumps({"error": f"only {ready}/{args.fleet} "
+                              f"replicas turned ready"}), flush=True)
+            return 1
+        print(json.dumps({"fleet": args.fleet, "models": args.models,
+                          "warmed": True,
+                          "spinup_secs": round(time.time() - t_up, 1)}),
+              flush=True)
+        router = FleetRouter(sup, request_timeout_s=60.0)
+        lat, drops = [], []
+        stop = threading.Event()
+        threads = [threading.Thread(
+            target=_client, args=(router, model_ids, X, args.batch,
+                                  stop, lat, drops, 100 + i),
+            daemon=True) for i in range(args.clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        if args.kill_cycle:
+            phase = max(args.seconds / 3.0, 2.0)
+            # phase 1: steady at full width
+            time.sleep(phase)
+            # phase 2: SIGKILL -> drain to siblings -> relaunch ->
+            # /readyz-gated rejoin, all under load
+            sup.kill_replica(0)
+            t_kill = time.time()
+            # the kill lands asynchronously: first watch the slot
+            # actually LEAVE the ready set (ready+alive both lag a
+            # SIGKILL by a beat), then wait for the relaunch to warm
+            # up and re-admit — otherwise this "rejoin" would be the
+            # stale pre-kill flags
+            while (sup.live_count() >= args.fleet
+                   and time.time() - t_kill < 30.0):
+                time.sleep(0.05)
+            while (sup.live_count() < args.fleet
+                   and time.time() - t_kill < 180.0):
+                time.sleep(0.1)
+            kill_cycle["relaunch_rejoin_secs"] = \
+                round(time.time() - t_kill, 1)
+            kill_cycle["rejoined"] = sup.live_count() == args.fleet
+            time.sleep(phase)
+            # phase 3: host-gone kill -> degrade to N-1, still serving
+            victim = args.fleet - 1
+            sup.kill_replica(victim, host_gone=True)
+            t_kill = time.time()
+            while (not sup.handles[victim].retired
+                   and time.time() - t_kill < 60.0):
+                time.sleep(0.1)
+            # settle to the degraded steady state before sampling:
+            # every non-retired slot back in the ready set
+            while (sup.live_count() < args.fleet - 1
+                   and time.time() - t_kill < 180.0):
+                time.sleep(0.1)
+            kill_cycle["degraded"] = sup.handles[victim].retired
+            kill_cycle["live_after_degrade"] = sup.live_count()
+            time.sleep(phase)
+        else:
+            time.sleep(args.seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        elapsed = time.time() - t0
+    finally:
+        if router is not None:
+            router.close()
+        sup.stop()
+
+    slat = sorted(lat)
+    p50, p99 = _quantile(slat, 0.50), _quantile(slat, 0.99)
+    rps = len(lat) / elapsed
+    cycles_ok = (not args.kill_cycle
+                 or (bool(kill_cycle.get("rejoined"))
+                     and bool(kill_cycle.get("degraded"))
+                     and kill_cycle.get("live_after_degrade")
+                     == args.fleet - 1))
+    met = (not drops and cycles_ok
+           and (p99 is None or p99 * 1000.0 <= args.p99_target_ms))
+    obs.set_gauge("bench.fleet_rps", round(rps, 1), force=True)
+    rec = {
+        "fleet": args.fleet, "clients": args.clients,
+        "seconds": round(elapsed, 1), "requests": len(lat),
+        "rps": round(rps, 1),
+        "pooled_p50_ms": _ms(p50), "pooled_p99_ms": _ms(p99),
+        "p99_target_ms": args.p99_target_ms,
+        "dropped": len(drops),
+        "relaunches": sup.relaunches, "degrades": sup.degrades,
+        "kill_cycle": kill_cycle or None,
+        "fleet_ok": 1 if met else 0,
+    }
+    print(json.dumps(rec), flush=True)
+    if args.metrics_json:
+        obs.dump_jsonl(args.metrics_json)
+    return 0 if met else 1
+
+
 def run_load(args):
     from lightgbm_tpu import obs
     from lightgbm_tpu.obs import slo as _slo
@@ -523,11 +671,24 @@ def main():
                     help="enable request-lifecycle tracing and export "
                          "a Chrome trace of the run there "
                          "(docs/observability.md 'Request tracing')")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="drive N replica PROCESSES through the "
+                         "elastic FleetRouter instead of one "
+                         "in-process service; reports aggregate RPS "
+                         "+ pooled p50/p99 (docs/serving.md 'Fleet "
+                         "deployment')")
+    ap.add_argument("--kill-cycle", action="store_true",
+                    help="with --fleet: SIGKILL one replica mid-load "
+                         "(relaunch + /readyz rejoin) then host-gone "
+                         "kill another (degrade to N-1); exit nonzero "
+                         "on any dropped request")
     ap.add_argument("--smoke", action="store_true",
                     help="fast CI gate (see run_smoke)")
     args = ap.parse_args()
     if args.smoke:
         return run_smoke(args)
+    if args.fleet:
+        return run_fleet(args)
     return run_load(args)
 
 

@@ -24,6 +24,7 @@ __all__ = [
     "record_metrics", "reset_parameter", "EarlyStopException",
     "checkpoint", "CheckpointManager", "CheckpointError", "obs",
     "ModelWatcher", "PredictService", "ModelRegistry",
+    "FleetSupervisor", "FleetRouter", "ReplicaModel",
 ]
 
 
@@ -52,7 +53,8 @@ def __getattr__(name):
         if name == "ModelWatcher":
             from . import serving as _sv
             return _sv.ModelWatcher
-        if name in ("PredictService", "ModelRegistry"):
+        if name in ("PredictService", "ModelRegistry",
+                    "FleetSupervisor", "FleetRouter", "ReplicaModel"):
             from . import serve as _srv
             return getattr(_srv, name)
     except ImportError as e:

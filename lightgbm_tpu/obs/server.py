@@ -170,17 +170,23 @@ def server() -> Optional[MetricsServer]:
 
 def start_server(port: int,
                  heartbeat_timeout_s: Optional[float] = None,
+                 required: bool = False,
                  ) -> Optional[MetricsServer]:
     """Start (or return) the process metrics endpoint. ``port=0`` binds
-    an ephemeral port (tests); the config path only calls this with
-    ``tpu_metrics_port > 0``. Idempotent and process-global: a second
-    DIFFERENT port warns and keeps the first, while an EXPLICIT
-    ``heartbeat_timeout_s`` (None = keep current / default) applies to
-    the live server in place — a later Config's tpu_heartbeat_timeout
-    must not be silently dropped, nor an unset one clobber an earlier
-    explicit choice. A port already in use warns and returns None —
-    the training/serving run continues without live exposition rather
-    than crashing."""
+    an ephemeral port (the ACTUALLY-bound port is on the returned
+    server's ``.port`` — fleet replicas bind 0 and publish what they
+    got); the config path only calls this with ``tpu_metrics_port >
+    0``. Idempotent and process-global: a second DIFFERENT port warns
+    and keeps the first, while an EXPLICIT ``heartbeat_timeout_s``
+    (None = keep current / default) applies to the live server in
+    place — a later Config's tpu_heartbeat_timeout must not be
+    silently dropped, nor an unset one clobber an earlier explicit
+    choice. A port already in use warns and returns None — the
+    training/serving run continues without live exposition rather than
+    crashing — UNLESS ``required=True``: a fleet replica whose
+    endpoint cannot bind is invisible to its router (the supervisor
+    would route around a silently-blind replica forever), so the fleet
+    path raises instead of degrading."""
     from ..utils import log
     global _server
     with _lock:
@@ -201,6 +207,12 @@ def start_server(port: int,
                                      if heartbeat_timeout_s is None
                                      else heartbeat_timeout_s))
         except OSError as e:
+            if required:
+                raise RuntimeError(
+                    f"metrics endpoint REQUIRED but cannot bind port "
+                    f"{port}: {e} (a replica without /metrics+/readyz "
+                    f"cannot join a fleet — pick a free port or "
+                    f"port=0 for ephemeral)") from e
             log.warning(
                 f"tpu_metrics_port={port}: cannot bind the metrics "
                 f"endpoint ({e}); live exposition disabled for this "

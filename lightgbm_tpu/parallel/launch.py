@@ -64,6 +64,20 @@ _RELAUNCH_ENV = "LGBM_TPU_GANG_RELAUNCH"
 _HB_FILE_RE = re.compile(r"^heartbeat\.train\.rank(\d+)$")
 
 
+def strip_fake_device_flags() -> None:
+    """Drop any ``--xla_force_host_platform_device_count`` flag from
+    this process's ``XLA_FLAGS``. Spawned children inherit the
+    parent's env; a fake-device-count flag (e.g. the test suite's
+    8-device CPU mesh) would multiply a worker's world size — each
+    localhost worker/replica gets ONE device. Call BEFORE the first
+    jax import in any spawned-process main."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" in flags:
+        os.environ["XLA_FLAGS"] = " ".join(
+            f for f in flags.split()
+            if "host_platform_device_count" not in f)
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -316,15 +330,7 @@ def run_worker(params: Dict, data_fn: Callable[[int, int], ShardSpec],
 def _spawn_main(rank, nproc, port, params, data_fn, num_boost_round,
                 platform, categorical_feature, queue, resume_from):
     try:
-        # children inherit the parent's env; a fake-device-count flag
-        # (e.g. the test suite's 8-device CPU mesh) would multiply the
-        # world size — each localhost worker gets ONE device
-        import os
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" in flags:
-            os.environ["XLA_FLAGS"] = " ".join(
-                f for f in flags.split()
-                if "host_platform_device_count" not in f)
+        strip_fake_device_flags()
         bst = run_worker(params, data_fn, num_boost_round, rank=rank,
                          num_processes=nproc,
                          coordinator=f"localhost:{port}",

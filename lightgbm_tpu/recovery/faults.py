@@ -194,6 +194,29 @@ def clear_host_gone_markers(directory,
     return removed
 
 
+def write_host_gone_marker(directory, rank: int,
+                           note: str = "") -> Optional[str]:
+    """Declare ``rank``'s host permanently lost: write the
+    ``.host_gone.rank<r>`` marker the degrade paths consume (the gang
+    launcher narrows past it; the serving-fleet supervisor retires the
+    replica instead of relaunching). The ``resize`` chaos fault and
+    the fleet kill helpers both route through here so the marker name
+    lives in one place. Returns the marker path (None on I/O
+    failure — the caller logs, the kill still proceeds)."""
+    directory = str(directory or "")
+    if not directory:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory,
+                            f"{_HOST_GONE_PREFIX}{int(rank)}")
+        with open(path, "w") as f:
+            f.write((str(note) + "\n") if note else "")
+        return path
+    except OSError:
+        return None
+
+
 @dataclass
 class FaultPlan:
     kind: str                   # one of FAULT_KINDS
@@ -253,16 +276,10 @@ class FaultPlan:
         random draws, so the spec text alone replays it."""
         d = self.marker_dir or self.ckpt_dir
         if d:
-            os.makedirs(d, exist_ok=True)
             for q in self.ranks:
-                try:
-                    with open(os.path.join(
-                            d, f"{_HOST_GONE_PREFIX}{int(q)}"),
-                            "w") as f:
-                        f.write(self.spec + "\n")
-                except OSError as e:
+                if write_host_gone_marker(d, q, note=self.spec) is None:
                     log.warning(f"tpu_fault_inject: cannot write "
-                                f"host-gone marker for rank {q}: {e}")
+                                f"host-gone marker for rank {q}")
         else:
             log.warning(
                 f"tpu_fault_inject: resize fault has no marker/"

@@ -14,11 +14,17 @@ Three layers, one import:
   too large for one device (``tpu_serve_shard_trees``), bit-identical
   to single-device predict.
 
-Entry point: :class:`~.service.PredictService`.
+One process: :class:`~.service.PredictService`. N replicas of it
+behind an elastic router: :class:`~.fleet.FleetSupervisor` +
+:class:`~.router.FleetRouter` (serve/fleet.py, serve/router.py —
+docs/serving.md "Fleet deployment").
 """
+from .fleet import FleetSupervisor, ReplicaModel
 from .registry import ModelRegistry
+from .router import FleetRouter
 from .service import PredictService
 from .shard import enable_tree_sharding, tree_mesh
 
-__all__ = ["PredictService", "ModelRegistry", "enable_tree_sharding",
+__all__ = ["PredictService", "ModelRegistry", "FleetSupervisor",
+           "FleetRouter", "ReplicaModel", "enable_tree_sharding",
            "tree_mesh"]

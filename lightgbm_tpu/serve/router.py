@@ -1,0 +1,253 @@
+"""FleetRouter: the thin in-process front of a serving fleet.
+
+The router owns the zero-drop contract the fleet advertises
+(docs/serving.md "Fleet deployment"): every ``submit`` Future
+resolves EXACTLY ONCE — with the predicted rows, with the request's
+own error (unknown model, malformed payload), or with an explicit
+shutdown/exhaustion RuntimeError. Never silently.
+
+How it gets there:
+
+- **Admission**: requests only go to handles the supervisor marked
+  ready (``/readyz`` green — the warmup-gated readiness contract). A
+  joining or relaunched replica takes zero routed traffic until its
+  steady state is compiled; tests/test_fleet.py pins this via the
+  router's per-rank dispatch counters.
+- **Placement**: least-loaded by (router-side in-flight count +
+  the replica's last-scraped ``slo.queue_depth``) — the same backlog
+  signal a load balancer would scrape from ``/metrics``, kept warm by
+  the supervisor's monitor loop at zero extra scrape traffic.
+- **Failover**: the router HOLDS each request until its future
+  settles. A connection error / 5xx / timeout marks a REPLICA attempt
+  failed (``fleet.router_retries``); the request backs off and
+  re-dispatches to a sibling (``fleet.redispatches`` once per request
+  that had already reached a replica). Predict is pure, so a replica
+  that died AFTER computing but BEFORE replying costs a duplicate
+  compute, never a wrong or dropped answer. 404/400 are REQUEST
+  errors: the future fails immediately, no retry burned.
+- **Bounded budget**: ``retries`` sibling attempts (plus the first)
+  and a wall-clock deadline per request; exhaustion resolves the
+  future with a RuntimeError naming every attempt. ``close()``
+  resolves anything still queued the same way — the no-silent-drop
+  guarantee survives shutdown.
+"""
+from __future__ import annotations
+
+import io
+import queue as _queue
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import obs
+from ..utils import log
+from .fleet import FleetSupervisor, ReplicaHandle
+
+__all__ = ["FleetRouter"]
+
+
+class _RequestError(Exception):
+    """The REQUEST is bad (unknown model, malformed payload) — every
+    replica would refuse it identically; fail fast, burn no retries."""
+
+
+class _Req:
+    __slots__ = ("model_id", "payload", "rows", "future", "deadline",
+                 "attempts", "touched")
+
+    def __init__(self, model_id: str, X, deadline: float):
+        self.model_id = model_id
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(X, np.float64), allow_pickle=False)
+        self.payload = buf.getvalue()
+        self.rows = int(np.asarray(X).shape[0])
+        self.future: Future = Future()
+        self.deadline = deadline
+        self.attempts = 0
+        self.touched: List[int] = []    # ranks that saw this request
+
+
+class FleetRouter:
+    """Least-loaded router with retry/redispatch over a
+    :class:`~.fleet.FleetSupervisor`'s ready replicas."""
+
+    def __init__(self, supervisor: FleetSupervisor, *,
+                 retries: int = 4, backoff_s: float = 0.05,
+                 backoff_cap_s: float = 1.0,
+                 request_timeout_s: float = 60.0,
+                 workers: Optional[int] = None):
+        self.sup = supervisor
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.request_timeout_s = float(request_timeout_s)
+        # one worker per replica slot plus slack: a worker blocks for
+        # its request's whole retry saga, so the pool bounds router
+        # concurrency, not correctness
+        self.workers = int(workers) if workers \
+            else max(2 * supervisor.n_replicas, 4)
+        self._q: "_queue.Queue[Optional[_Req]]" = _queue.Queue()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # per-rank routed-dispatch counters — the joining-replica
+        # admission invariant is asserted against these (a rank absent
+        # here received ZERO routed requests; warmup traffic is the
+        # replica's own and never passes the router)
+        self.dispatch_counts: Dict[int, int] = {}
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"lgbm-tpu-router-{i}")
+            for i in range(self.workers)]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, model_id: str, X) -> Future:
+        """Enqueue one request; the Future resolves exactly once."""
+        if self._stop.is_set():
+            raise RuntimeError("fleet router is closed")
+        req = _Req(model_id, X,
+                   time.monotonic() + self.request_timeout_s)
+        self._q.put(req)
+        return req.future
+
+    def predict(self, model_id: str, X,
+                timeout: Optional[float] = None) -> np.ndarray:
+        return self.submit(model_id, X).result(timeout=timeout)
+
+    def close(self) -> None:
+        """Stop the workers; anything still undispatched resolves with
+        an explicit shutdown error (never a silent drop)."""
+        self._stop.set()
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=5.0)
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            if req is not None and not req.future.done():
+                req.future.set_exception(RuntimeError(
+                    "fleet: router closed before dispatch"))
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            req = self._q.get()
+            if req is None:
+                return
+            try:
+                self._run_one(req)
+            except Exception as e:      # belt-and-braces: never drop
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    def _pick(self, req: _Req) -> Optional[ReplicaHandle]:
+        """Least-loaded ready replica, preferring ranks this request
+        has not yet touched (a relaunched generation of a touched rank
+        is fair game again — membership may be down to one)."""
+        ready = self.sup.ready_handles()
+        if not ready:
+            return None
+        fresh = [h for h in ready if h.rank not in req.touched]
+        pool = fresh or ready
+        return min(pool, key=lambda h: h.inflight + h.depth)
+
+    def _run_one(self, req: _Req) -> None:
+        delay = self.backoff_s
+        while True:
+            if req.future.done():       # caller cancelled
+                return
+            h = self._pick(req)
+            if h is None:
+                # no ready replica RIGHT NOW (mid-relaunch, warming):
+                # wait within the deadline — elastic membership means
+                # capacity usually returns
+                if time.monotonic() >= req.deadline:
+                    self._exhaust(req, "no ready replica")
+                    return
+                time.sleep(0.02)
+                continue
+            req.attempts += 1
+            if req.touched:
+                # this request already reached a replica and is now
+                # being sent elsewhere — the in-flight work of a dying
+                # replica re-dispatching instead of dropping
+                obs.inc("fleet.redispatches", force=True)
+            req.touched.append(h.rank)
+            with self._lock:
+                self.dispatch_counts[h.rank] = \
+                    self.dispatch_counts.get(h.rank, 0) + 1
+            h.inflight += 1
+            try:
+                out = self._call(h, req)
+            except _RequestError as e:
+                req.future.set_exception(RuntimeError(str(e)))
+                return
+            except Exception as e:
+                obs.inc("fleet.router_retries", force=True)
+                log.warning(f"fleet: attempt {req.attempts} at replica "
+                            f"{h.rank} failed ({type(e).__name__}: "
+                            f"{e}); retrying a sibling")
+                if (req.attempts > self.retries
+                        or time.monotonic() >= req.deadline):
+                    self._exhaust(req, f"last error: {e}")
+                    return
+                time.sleep(min(delay, self.backoff_cap_s))
+                delay *= 2
+                continue
+            finally:
+                h.inflight -= 1
+            if not req.future.done():
+                req.future.set_result(out)
+            return
+
+    def _exhaust(self, req: _Req, why: str) -> None:
+        if not req.future.done():
+            req.future.set_exception(RuntimeError(
+                f"fleet: request for model {req.model_id!r} "
+                f"({req.rows} rows) failed after {req.attempts} "
+                f"attempt(s) across replicas {req.touched} — {why}"))
+
+    # ------------------------------------------------------------------
+    def _call(self, h: ReplicaHandle, req: _Req) -> np.ndarray:
+        url = (f"{h.predict_url}/predict?model="
+               f"{urllib.parse.quote(req.model_id)}")
+        # per-attempt timeout: a replica that dies mid-reply must not
+        # eat the whole request deadline before the sibling retry
+        budget = max(min(self.sup.predict_timeout_s,
+                         req.deadline - time.monotonic()), 0.1)
+        r = urllib.request.Request(
+            url, data=req.payload,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(r, timeout=budget) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode("utf-8", "replace")
+            except Exception:
+                pass
+            if e.code in (400, 404):
+                raise _RequestError(
+                    f"replica {h.rank} refused request ({e.code}): "
+                    f"{detail}") from None
+            raise RuntimeError(f"replica {h.rank} HTTP {e.code}: "
+                               f"{detail}") from None
+        return np.load(io.BytesIO(body), allow_pickle=False)

@@ -121,6 +121,13 @@ class PredictService:
         _track_queue(self.queue)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # riders of the batch CURRENTLY mid-dispatch (0 when the loop
+        # is between batches). The queue's depth() drops at pop, so
+        # depth alone cannot tell "idle" from "wedged inside predict"
+        # — the fleet replica's liveness loop (serve/fleet.py) stamps
+        # heartbeat.serve only while depth()==0 AND inflight==0, so a
+        # wedged dispatch goes /readyz-stale and gets replaced
+        self._inflight = 0
         if start:
             self.start()
 
@@ -166,6 +173,13 @@ class PredictService:
         self.registry.register(model_id, booster, watch_dir=watch_dir,
                                watch_interval=watch_interval)
         return self
+
+    @property
+    def inflight(self) -> int:
+        """Riders of the batch currently mid-dispatch (0 between
+        batches) — with ``queue.depth()``, the replica idle/wedged
+        discriminator."""
+        return self._inflight
 
     def submit(self, model_id: str, X) -> Future:
         """Enqueue one request; the Future resolves to exactly the rows
@@ -227,6 +241,7 @@ class PredictService:
             if item is None:
                 continue
             model_id, batch = item
+            self._inflight = len(batch)
             try:
                 self._dispatch(model_id, batch)
             except Exception as e:   # belt-and-braces: the loop lives on
@@ -235,6 +250,8 @@ class PredictService:
                         _resolve(req, exc=e)
                 log.warning(f"serve: dispatch for model "
                             f"{model_id!r} failed ({e})")
+            finally:
+                self._inflight = 0
 
     def _dispatch(self, model_id: str,
                   batch: List[PredictRequest],

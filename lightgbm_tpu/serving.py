@@ -59,6 +59,7 @@ the metrics pillar off; docs/observability.md catalogue):
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -88,6 +89,13 @@ class ModelWatcher:
         self.interval = max(float(interval), 0.0)
         self.rank = int(rank)
         self._mgr = CheckpointManager(self.dir, rank=self.rank)
+        # per-watcher jitter source: N fleet replicas watching ONE
+        # checkpoint dir must not stat/unpickle in lockstep after each
+        # publish (thundering herd on the shared filesystem) — each
+        # poll waits interval * U(0.8, 1.2), desynchronizing replicas
+        # that started together within a few polls
+        self._jitter = random.Random()
+        self._next_wait = self.interval
         # the swap/predict serialization point (module docstring
         # THREADING CONTRACT): reentrant so a predict already holding
         # it can poll-and-swap on its own thread without deadlock
@@ -144,9 +152,12 @@ class ModelWatcher:
         raises for checkpoint-side problems — a serving process must
         keep serving the previous model through ANY publish failure."""
         now = time.monotonic()
-        if not force and now - self._last_poll < self.interval:
+        if not force and now - self._last_poll < self._next_wait:
             return False
         self._last_poll = now
+        # draw the NEXT poll's jittered wait (interval=0 stays 0 —
+        # tests and force-poll callers poll every call)
+        self._next_wait = self.interval * self._jitter.uniform(0.8, 1.2)
         try:
             sig = self._signature()
         except Exception:

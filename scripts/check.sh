@@ -76,7 +76,7 @@ rm -f "$CHAOS_JSON"
 JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
 python benchmarks/chaos_bench.py --smoke 2>&1 | tee "$CHAOS_JSON" \
   || CHAOS_SMOKE=0
-ELASTIC_SMOKE=$(python - "$CHAOS_JSON" <<'PY'
+ELASTIC_SMOKE=$(python - "$CHAOS_JSON" elastic_smoke <<'PY'
 import json, sys
 v = 0
 try:
@@ -84,8 +84,27 @@ try:
         ln = ln.strip()
         if ln.startswith("{"):
             d = json.loads(ln)
-            if "elastic_smoke" in d:
-                v = int(d["elastic_smoke"])
+            if sys.argv[2] in d:
+                v = int(d[sys.argv[2]])
+except Exception:
+    v = 0
+print(v)
+PY
+)
+# serving-fleet kill/join cycle riding the same smoke (3 replicas,
+# kill one mid-load, relaunch + degrade, ZERO dropped requests;
+# docs/serving.md "Fleet deployment") — enforced absolutely by
+# obs_trend.py and by exit 9 here
+FLEET_SMOKE=$(python - "$CHAOS_JSON" fleet_smoke <<'PY'
+import json, sys
+v = 0
+try:
+    for ln in open(sys.argv[1]):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            d = json.loads(ln)
+            if sys.argv[2] in d:
+                v = int(d[sys.argv[2]])
 except Exception:
     v = 0
 print(v)
@@ -121,11 +140,12 @@ LINT_FINDINGS=$(cat "$LINT_COUNT_FILE" 2>/dev/null || echo -1)
 # dots/seconds from this run plus compile count and peak-HBM estimate
 # read back from the snapshot. A malformed dump FAILS the gate — a
 # check that silently skips its own telemetry is how telemetry rots.
-python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" "$SERVE_JSON" "$ELASTIC_SMOKE" <<'PY' >> scripts/check_timings.log
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" "$SERVE_JSON" "$ELASTIC_SMOKE" "$FLEET_SMOKE" <<'PY' >> scripts/check_timings.log
 import json, sys, time
 path, mode, dots, secs, rev, stream_ok, chaos_ok, lint, serve_ok = sys.argv[1:10]
 serve_json = sys.argv[10] if len(sys.argv) > 10 else ""
 elastic_ok = sys.argv[11] if len(sys.argv) > 11 else "0"
+fleet_ok = sys.argv[12] if len(sys.argv) > 12 else "0"
 try:
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     snap = json.loads(lines[-1])
@@ -180,6 +200,9 @@ print("obs " + json.dumps({
     # elastic resize cycle riding the same smoke: kill -> resume
     # NARROWER -> bit-equality + zero dropped predicts
     "elastic_smoke": int(elastic_ok),
+    # serving-fleet kill/join cycle riding the same smoke: 3 replicas,
+    # kill one mid-load -> relaunch + degrade -> zero dropped requests
+    "fleet_smoke": int(fleet_ok),
     # concurrent serving: coalesce + evict + swap under load with zero
     # drops and zero warm compiles (benchmarks/serve_bench.py --smoke)
     "serve_smoke": int(serve_ok),
@@ -205,6 +228,11 @@ if [[ "$ELASTIC_SMOKE" != 1 ]]; then
   echo "check.sh: elastic smoke FAILED (kill+resume-narrower re-cut;" \
        "status logged)"
   exit 8
+fi
+if [[ "$FLEET_SMOKE" != 1 ]]; then
+  echo "check.sh: serving-fleet smoke FAILED (kill/join cycle under" \
+       "load; status logged)"
+  exit 9
 fi
 if [[ "$LINT_FINDINGS" != 0 ]]; then
   echo "check.sh: static analysis FAILED ($LINT_FINDINGS finding(s);" \

@@ -43,6 +43,11 @@ regressed past its threshold —
   the concurrent serving smoke (``benchmarks/serve_bench.py --smoke``
   — coalesce + LRU-evict + mid-traffic hot-swap under load) dropped a
   request, compiled a warm-path program, or crashed;
+- ``fleet_smoke`` == 0 in the NEWEST run (absolute, like
+  elastic_smoke): the serving-fleet kill/join cycle riding the chaos
+  smoke (3 replicas behind the router, one SIGKILLed mid-load →
+  relaunch + degrade; docs/serving.md "Fleet deployment") dropped a
+  request, admitted traffic at an unready replica, or crashed;
 - ``lint_findings`` != 0 in the NEWEST run (absolute): the static
   analysis suite (``python -m tools.analyze``;
   docs/static-analysis.md) reported drift findings — or crashed
@@ -171,6 +176,16 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
             "bit-equality, dropped a predict, or crashed "
             "(benchmarks/chaos_bench.py --smoke; docs/robustness.md "
             "'Elastic topology')")
+    # the fleet smoke is absolute like the elastic one: a replica kill
+    # that dropped a request, or traffic routed at a replica that
+    # never passed /readyz, is a broken failover NOW
+    if _num(newest, "fleet_smoke") == 0.0:
+        failures.append(
+            "fleet smoke FAILED (fleet_smoke=0): the serving-fleet "
+            "kill/join cycle (3 replicas, kill one mid-load -> "
+            "relaunch + degrade) dropped a request or crashed "
+            "(benchmarks/chaos_bench.py --smoke; docs/serving.md "
+            "'Fleet deployment')")
     # the serving smoke is absolute the same way: a dropped request or
     # a warm-path compile under coalesce + evict + swap load is broken
     # NOW, whatever the trailing median says

@@ -1,0 +1,180 @@
+"""Serving fleet (lightgbm_tpu/serve/fleet.py + serve/router.py).
+
+What these tests pin (the ISSUE's router failover invariants):
+
+* **Exactly-once across a mid-traffic SIGKILL** — every request
+  submitted while one replica is SIGKILLed resolves exactly once with
+  CORRECT rows (verified against the offline ``Booster.predict`` of
+  the same rows): no drop, no double-dispatch observable through a
+  future, no wrong answer from a torn reply.
+* **Readiness-gated admission** — a joining replica that has not
+  passed ``/readyz`` (its bucketed warmup has not completed) receives
+  ZERO routed requests; the router's per-rank dispatch counters are
+  the witness.
+* **Degrade-and-continue** — a host-gone marker retires the slot
+  permanently (no relaunch burn) and the remaining fleet keeps
+  answering correctly.
+* **Watcher jitter** — N replicas polling one checkpoint dir draw
+  per-poll intervals in ±20% of the configured one (thundering-herd
+  satellite; unit-level, no processes).
+
+Replica processes spawn with a JAX import each — the module is marked
+slow-ish but stays minutes-not-hours by using one tiny model, a
+128-row batch cap (warmup = one bucket), and 2-replica fleets.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import FleetRouter, FleetSupervisor, ReplicaModel
+from lightgbm_tpu.serving import ModelWatcher
+
+# serving params every fleet in this module uses: the smallest legal
+# batch cap makes warmup a single pow2 bucket (fast readiness), and a
+# short budget keeps micro-batching latency out of test wall-clock
+_PARAMS = {"tpu_serve_max_batch_rows": 128,
+           "tpu_serve_batch_budget_ms": 2.0}
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(300, 6))
+    y = (X[:, 0] - X[:, 2] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    return bst, X
+
+
+def _fleet(tiny_model, n, **kw):
+    bst, X = tiny_model
+    spec = [ReplicaModel(model_id="m", model_str=bst.model_to_string(),
+                         warmup_row=X[0])]
+    return FleetSupervisor(_PARAMS, spec, n, heartbeat_timeout=8.0,
+                           **kw)
+
+
+def test_exactly_once_with_correct_rows_across_sigkill(tiny_model):
+    bst, X = tiny_model
+    sup = _fleet(tiny_model, 2, max_restarts=2)
+    sup.start()
+    try:
+        assert sup.wait_ready(2, timeout=120) == 2
+        with FleetRouter(sup, request_timeout_s=90.0) as router:
+            # concurrent slices, each with its own offline reference
+            slices = [X[i:i + 8] for i in range(0, 240, 8)]
+            futs = [router.submit("m", s) for s in slices]
+            # kill one replica while those are in flight
+            sup.kill_replica(0)
+            results = [f.result(timeout=120) for f in futs]
+            # exactly once: every future resolved, with ITS rows
+            assert len(results) == len(slices)
+            for s, out in zip(slices, results):
+                np.testing.assert_allclose(out, bst.predict(s),
+                                           atol=1e-6)
+            # and the fleet healed: the slot relaunches and re-admits
+            deadline = time.monotonic() + 120
+            while sup.live_count() < 2 and time.monotonic() < deadline:
+                time.sleep(0.2)
+            assert sup.live_count() == 2
+            assert sup.relaunches >= 1
+            out = router.predict("m", X[:16], timeout=60)
+            np.testing.assert_allclose(out, bst.predict(X[:16]),
+                                       atol=1e-6)
+    finally:
+        sup.stop()
+
+
+def test_joining_replica_gets_zero_traffic_before_readyz(tiny_model):
+    bst, X = tiny_model
+    # rank 1 publishes its endpoint, then sleeps BEFORE warmup: it is
+    # discoverable but /readyz-503 for several seconds — the admission
+    # window the invariant is about
+    sup = _fleet(tiny_model, 2, warmup_delay_s=6.0,
+                 slow_warmup_ranks=(1,))
+    sup.start()
+    try:
+        assert sup.wait_ready(1, timeout=120) >= 1
+        with FleetRouter(sup, request_timeout_s=60.0) as router:
+            stop = threading.Event()
+
+            def _traffic(out):
+                while not stop.is_set():
+                    out.append(router.submit("m", X[:4]))
+                    time.sleep(0.01)
+
+            futs = []
+            t = threading.Thread(target=_traffic, args=(futs,))
+            t.start()
+            # while rank 1 is alive-but-unready, it gets NOTHING
+            deadline = time.monotonic() + 30
+            admitted = False
+            while time.monotonic() < deadline:
+                # read the counter BEFORE the flag: admission requires
+                # ready=True, so a nonzero count with ready still False
+                # at the LATER read is a real violation, while a count
+                # that raced the flag flipping true is not
+                count = router.dispatch_counts.get(1, 0)
+                if sup.handles[1].ready:
+                    admitted = True
+                    break
+                assert count == 0, (
+                    "router dispatched at a replica that never "
+                    "passed /readyz")
+                time.sleep(0.05)
+            stop.set()
+            t.join(timeout=10)
+            assert admitted, "slow joiner never turned ready"
+            for f in futs:
+                np.testing.assert_allclose(
+                    f.result(timeout=60), bst.predict(X[:4]), atol=1e-6)
+    finally:
+        sup.stop()
+
+
+def test_host_gone_marker_degrades_to_n_minus_1(tiny_model):
+    bst, X = tiny_model
+    sup = _fleet(tiny_model, 2, max_restarts=2)
+    sup.start()
+    try:
+        assert sup.wait_ready(2, timeout=120) == 2
+        with FleetRouter(sup, request_timeout_s=90.0) as router:
+            futs = [router.submit("m", X[i:i + 4])
+                    for i in range(0, 80, 4)]
+            sup.kill_replica(1, host_gone=True)
+            for i, f in enumerate(futs):
+                np.testing.assert_allclose(
+                    f.result(timeout=120),
+                    bst.predict(X[4 * i:4 * i + 4]), atol=1e-6)
+            deadline = time.monotonic() + 60
+            while sup.degrades < 1 and time.monotonic() < deadline:
+                time.sleep(0.2)
+            # retired, not relaunched: the marker is consumed, the
+            # slot stays down, the survivor answers
+            assert sup.degrades == 1
+            assert sup.handles[1].retired
+            out = router.predict("m", X[:16], timeout=60)
+            np.testing.assert_allclose(out, bst.predict(X[:16]),
+                                       atol=1e-6)
+            assert sup.live_count() == 1
+    finally:
+        sup.stop()
+
+
+def test_watcher_poll_jitter_stays_within_20pct(tmp_path):
+    w = ModelWatcher(str(tmp_path), interval=1.0)
+    draws = set()
+    for _ in range(200):
+        w._last_poll = 0.0          # defeat the rate limit, keep the
+        w.maybe_swap(booster=None)  # draw path honest
+        assert 0.8 <= w._next_wait <= 1.2
+        draws.add(round(w._next_wait, 6))
+    assert len(draws) > 10, "jitter draws look constant"
+    # interval=0 (tests poll every call) must stay exactly 0
+    w0 = ModelWatcher(str(tmp_path), interval=0.0)
+    w0.maybe_swap(booster=None)
+    assert w0._next_wait == 0.0

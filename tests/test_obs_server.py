@@ -110,6 +110,35 @@ def test_port_in_use_disables_instead_of_crashing():
         blocker.close()
 
 
+def test_port_in_use_raises_when_required():
+    """The fleet path (serve/fleet.py) asks for required=True: a
+    replica whose /metrics+/readyz cannot bind is invisible to its
+    router — it must fail its launch loudly, not serve blind."""
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        with pytest.raises(RuntimeError, match="REQUIRED"):
+            obs_server.start_server(port, required=True)
+        assert obs_server.server() is None
+    finally:
+        blocker.close()
+
+
+def test_ephemeral_port_exposes_actually_bound_port():
+    """port=0 binds an ephemeral port and the returned server's .port
+    is the real one — fleet replicas bind 0 and publish what they
+    got."""
+    srv = obs_server.start_server(0, required=True)
+    try:
+        assert srv is not None and srv.port > 0
+        code, _body = _get(srv.url + "/healthz")
+        assert code in (200, 503)     # answering proves the port
+    finally:
+        obs_server.stop_server()
+
+
 def test_start_server_is_idempotent_and_daemonized():
     srv = obs_server.start_server(0)
     assert srv._thread.daemon            # cannot hang process exit

@@ -102,6 +102,24 @@ def test_elastic_smoke_failure_fails_even_without_history(tmp_path):
     assert rc == 0, out
 
 
+def test_fleet_smoke_failure_fails_even_without_history(tmp_path):
+    """The serving-fleet pin is ABSOLUTE like elastic_smoke: a
+    fleet_smoke=0 newest entry (the kill/join cycle dropped a request
+    or routed at an unready replica) fails with no baseline at all,
+    and a 1 (or an absent key, for pre-fleet logs) stays green."""
+    bad = "obs " + json.dumps(
+        dict(json.loads(_obs_line()[len("obs "):]), fleet_smoke=0))
+    rc, out = _run(tmp_path, [bad])
+    assert rc == 1
+    assert "fleet_smoke" in out
+    good = "obs " + json.dumps(
+        dict(json.loads(_obs_line()[len("obs "):]), fleet_smoke=1))
+    rc, out = _run(tmp_path, [good])
+    assert rc == 0, out
+    rc, out = _run(tmp_path, [_obs_line()])   # key absent: old logs
+    assert rc == 0, out
+
+
 def test_compile_and_hbm_regressions_fail(tmp_path):
     base = [_obs_line() for _ in range(4)]
     rc, out = _run(tmp_path, base + [_obs_line(compile_requests=200)])
