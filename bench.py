@@ -290,6 +290,14 @@ def main():
                          "sharded'). Emits stream_shards= / "
                          "stream_rows_per_sec= / allreduce_bytes= on "
                          "the metric line; 0 disables")
+    ap.add_argument("--no-stream-overlap", dest="stream_overlap",
+                    action="store_false", default=True,
+                    help="run the streamed probe with "
+                         "tpu_stream_overlap=false (synchronous "
+                         "per-block dispatch) — the A/B arm for the "
+                         "collective-hiding pipeline (docs/perf.md "
+                         "'Communication/compute overlap'); the "
+                         "metric line tags overlap=off")
     ap.add_argument("--metrics-json", type=str, default="",
                     help="append one obs metrics-snapshot JSONL line "
                          "(docs/observability.md schema) to PATH; also "
@@ -404,7 +412,9 @@ def main():
         sp = {"objective": "binary", "num_leaves": NUM_LEAVES,
               "max_bin": MAX_BIN, "learning_rate": 0.1,
               "verbosity": -1, "tpu_streaming": "true",
-              "tpu_stream_block_rows": 1 << 16}
+              "tpu_stream_block_rows": 1 << 16,
+              "tpu_stream_overlap":
+                  "auto" if args.stream_overlap else "false"}
         shards = max(1, jax.local_device_count())
         if shards > 1:
             sp["tree_learner"] = "data"
@@ -420,6 +430,8 @@ def main():
                       ns * s_trees / s_secs, force=True)
         obs.set_gauge("bench.stream_allreduce_bytes",
                       cs["allreduce_bytes"], force=True)
+        obs.set_gauge("bench.stream_overlap",
+                      1.0 if args.stream_overlap else 0.0, force=True)
         del sbst, sds
 
     peak = peak_hbm_gib()
@@ -450,11 +462,19 @@ def main():
     if v is not None:
         # --profile-dir attribution (scripts/trace_attr.py): fraction
         # of device busy in loop-state %copy ops — the signal the
-        # donation pass squeezes — plus the per-iter wall-vs-busy gap
+        # donation pass squeezes
         extras += f"; copy_share={v:.4f}"
-        g = _snap_gauge(snap, "train.wall_busy_gap_ms")
-        if g is not None:
-            extras += f"; wall_busy_gap_ms={g:.2f}"
+    v = _snap_gauge(snap, "train.comm_share")
+    if v is not None:
+        # collective busy share from the same attribution — read with
+        # the gap: overlap keeps comm busy, shrinks the gap
+        extras += f"; comm_share={v:.4f}"
+    v = _snap_gauge(snap, "train.wall_busy_gap_ms")
+    if v is not None:
+        # per-iter wall-vs-busy gap: the stall residue the overlap
+        # pipeline (and the donation pass before it) squeezes — carried
+        # whenever attribution ran, not only when copy_share did
+        extras += f"; wall_busy_gap_ms={v:.2f}"
     v = _snap_gauge(snap, "hist.rows_scanned")
     if v:
         # the structural win the partition exists for: total rows the
@@ -468,6 +488,8 @@ def main():
         extras += (
             f"; stream_shards="
             f"{int(_snap_gauge(snap, 'bench.stream_shards'))}"
+            f"; overlap="
+            f"{'on' if _snap_gauge(snap, 'bench.stream_overlap') else 'off'}"
             f"; stream_rows_per_sec={v:.0f}"
             f"; allreduce_bytes="
             f"{int(_snap_gauge(snap, 'bench.stream_allreduce_bytes'))}")

@@ -28,6 +28,8 @@ Usage:
   python benchmarks/streaming_probe.py --gib 2 --trees 3   # quick
   python benchmarks/streaming_probe.py --gib 32 --trees 2  # >HBM proof
   python benchmarks/streaming_probe.py --gib 1 --shards 1,2,4
+  python benchmarks/streaming_probe.py --gib 1 --shards 2 --no-overlap
+                                  # A/B arm: synchronous dispatch
 """
 import argparse
 import json
@@ -58,6 +60,11 @@ def main():
                          "SAME total rows (tree_learner=data + "
                          "tpu_mesh_shape); >1 on a single-device "
                          "platform uses fake CPU host devices")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="train with tpu_stream_overlap=false (fully "
+                         "synchronous per-block dispatch) — the A/B "
+                         "arm for docs/perf.md 'Communication/compute "
+                         "overlap'")
     args = ap.parse_args()
     shard_grid = [max(1, int(s)) for s in args.shards.split(",") if s]
     if max(shard_grid) > 1:
@@ -93,7 +100,9 @@ def main():
     rng = np.random.default_rng(0)
     params = {"objective": "binary", "num_leaves": args.leaves,
               "max_bin": 255, "verbosity": 1, "tpu_streaming": "true",
-              "learning_rate": 0.1}
+              "learning_rate": 0.1,
+              "tpu_stream_overlap":
+                  "false" if args.no_overlap else "auto"}
 
     t0 = time.time()
     # reference dataset: bin mappers from a 2M-row sample of the
@@ -148,6 +157,7 @@ def main():
             "sweeps_per_tree": sweeps,
             "n_blocks": eng.n_blocks,
             "stream_shards": shards,
+            "overlap": "off" if args.no_overlap else "on",
             "stream_rows_per_sec": round(n * args.trees / train_s, 1),
             "allreduce_calls": cs["allreduce_calls"],
             "allreduce_bytes": cs["allreduce_bytes"],

@@ -33,6 +33,7 @@ from ..ops.histogram import pad_rows
 from ..ops.predict import forest_predict_binned, tree_predict_binned
 from ..tree import Tree
 from ..utils import log
+from ..utils.prefetch import InflightWindow
 
 # once-per-process marker for the tpu_hist_partition=auto stand-down
 # warning (every train() builds a fresh GBDT; correct default behavior
@@ -2870,7 +2871,10 @@ class GBDT:
             obs.inc("predict.chunks", len(plan))
             obs.inc("predict.padded_rows",
                     sum(p - r for _s, r, p in plan))
-        pending: List[tuple] = []
+        # depth=1 window == the double buffer this loop hand-rolled
+        # before utils/prefetch.py existed: block on the oldest chunk's
+        # async D2H copy only once a second chunk is dispatched.
+        window = InflightWindow(1, drain)
         for start, rows, pad_to in plan:
             blk = bins[start:start + rows]
             if pad_to > rows:
@@ -2890,14 +2894,11 @@ class GBDT:
             if want_leaves:
                 # leaf-only request: the raw scores are never read back
                 leaves_dev.copy_to_host_async()
-                pending.append((None, leaves_dev, rows))
+                window.push((None, leaves_dev, rows))
             else:
                 raw_dev.copy_to_host_async()
-                pending.append((raw_dev, None, rows))
-            if len(pending) >= 2:   # double buffer: block on the oldest
-                drain(pending.pop(0))
-        while pending:
-            drain(pending.pop(0))
+                window.push((raw_dev, None, rows))
+        window.drain()
         if want_leaves:
             leaves = (leaf_parts[0] if len(leaf_parts) == 1
                       else np.concatenate(leaf_parts, axis=1))[:n_trees]

@@ -44,6 +44,14 @@ epochs"; §3.4 data-parallel learner for the sharded composition):
 - Per-row state (score, leaf id) lives device-resident per block;
   gradients are recomputed on device per block from the streamed
   score (cheaper than streaming g/h separately).
+- PIPELINED (``tpu_stream_overlap``, default on): the next block's
+  upload stages on a worker thread while the device sweeps the
+  current one, the per-level histogram collective dispatches without
+  a blocking host sync, and the round-end score sweep drains behind
+  the next round's first level sweep. Bit-identical on/off by
+  construction — only where the host blocks moves — and checkpoint
+  exports drain pending updates first (docs/perf.md
+  "Communication/compute overlap").
 - BAGGING / GOSS ride per-block row masks derived on device from a
   counter-based hash of each row's GLOBAL index — no mask storage, no
   host traffic, and the same row keeps the same draw no matter how
@@ -97,6 +105,7 @@ from ..ops.pallas_histogram import multi_leaf_histogram_xla
 from ..ops.split import SplitConfig, find_best_split
 from ..tree import Tree
 from ..utils import log
+from ..utils.prefetch import BlockPrefetcher, InflightWindow
 
 # |g*h| bucket count for the GOSS threshold histogram: the top 16 bits
 # of the positive-f32 bit pattern (8 exponent + 8 mantissa bits) are
@@ -346,6 +355,37 @@ class StreamingGBDT:
         # obs registry mirrors them when metrics are enabled
         self.comm_stats = {"allreduce_calls": 0, "allreduce_bytes": 0,
                            "blocks_scanned": 0, "levels": 0}
+
+        # communication/compute overlap (tpu_stream_overlap; docs/
+        # perf.md "Communication/compute overlap"). auto = on: the
+        # three pipelining moves (threaded H2D block staging, no host
+        # sync before the per-level collective, deferred final sweep)
+        # only change where the HOST blocks — accumulation order,
+        # reduce payloads and score arithmetic are untouched, so the
+        # trees are bit-identical on/off by construction. "false" is
+        # the synchronous A/B arm (attribution + escape hatch).
+        self._overlap = str(config.tpu_stream_overlap) != "false"
+        # per-rank in-flight sweep windows, PERSISTENT across level
+        # sweeps, the final sweep, and round boundaries: an item is
+        # (bins_upload, sweep_output); completing it host-blocks on
+        # the output and frees the upload. depth=1 keeps the historic
+        # 2-block transient bound (~512 MB/rank at the default block).
+        # Under overlap the windows deliberately stay non-empty across
+        # the level->find and final->next-round seams — that IS the
+        # pipelining; export_train_state drains them first (the PR 13
+        # contract; _drain_inflight below).
+        def _complete_inflight(item):
+            bins_blk, done = item
+            jax.block_until_ready(done)
+            bins_blk.delete()
+        self._inflight = [InflightWindow(1, _complete_inflight)
+                          for _ in self._ranks]
+        # cyclic one-ahead upload prefetcher over the step-major block
+        # schedule (built lazily: _block_schedule needs the rank
+        # layout final). Every sweep consumes exactly one full cycle,
+        # so the feed stays aligned at sweep boundaries; take(expect=)
+        # makes any drift a loud error.
+        self._feed = None
 
         # buffer donation for the streamed score slots (tpu_donate;
         # docs/perf.md "Iteration floor"): each block's [block_rows]
@@ -1046,6 +1086,51 @@ class StreamingGBDT:
         return {"leaf": z - 1, "feat": z, "thr": z, "dl": z,
                 "new_leaf": z, "nb": z, "hn": z}
 
+    # --------------------------------------------- block upload staging
+    def _block_schedule(self):
+        """The step-major ``(ri, b, lo, hi)`` dispatch order EVERY
+        streamed sweep iterates (level sweeps, the final sweep, the
+        next round's sweeps — identical by construction), flattened
+        for the cyclic upload prefetcher."""
+        iters = [list(self._rank_blocks(ri))
+                 for ri in range(len(self._ranks))]
+        seq = []
+        for step in range(max(len(it) for it in iters)):
+            for ri in range(len(iters)):
+                if step < len(iters[ri]):
+                    b, lo, hi = iters[ri][step]
+                    seq.append((ri, b, lo, hi))
+        return seq
+
+    def _stage_bins(self, item):
+        """Stage one block's bins on its rank's device. Runs on the
+        prefetch worker thread under overlap: slice + pad + device_put
+        ONLY — never a collective (utils/prefetch.py's threading
+        contract; the collective-safety checker pins it)."""
+        ri, _b, lo, hi = item
+        return self._put(self._pad_block(self.binned, lo, hi),
+                         self._ranks[ri]["dev"])
+
+    def _next_bins(self, ri, b, lo, hi):
+        """The next scheduled block's padded bins upload: staged one
+        step ahead on the worker thread under overlap (the host
+        slices/pads/wires block i+1 while the device sweeps block i),
+        staged inline — the historic order — when overlap is off."""
+        if self._feed is None:
+            self._feed = BlockPrefetcher(
+                self._stage_bins, self._block_schedule(),
+                threaded=self._overlap)
+        return self._feed.take(expect=(ri, b, lo, hi))
+
+    def _drain_inflight(self) -> None:
+        """Complete every pending streamed dispatch: host-block on the
+        in-flight sweep outputs and free their bins uploads. The PR 13
+        checkpoint contract — ``export_train_state`` must only ever
+        see fully materialized score slots — and the synchronous-mode
+        sweep barrier both land here."""
+        for win in self._inflight:
+            win.drain()
+
     def _level_hists(self, table, frontier_np, sampf, sampi):
         """One streamed pass over every local rank's blocks: apply the
         pending split table, accumulate each rank's [K, F, B, 3] level
@@ -1062,7 +1147,6 @@ class StreamingGBDT:
             sampf_dev.append(self._put(sampf, dev))
             sampi_dev.append(self._put(sampi, dev))
         hists = [None] * n_ranks
-        prev = [None] * n_ranks  # per rank: (bins_blk, hist-after-it)
         iters = [list(self._rank_blocks(ri)) for ri in range(n_ranks)]
         blocks = 0
         # BLOCK-STEP-MAJOR over the ranks: dispatch step s for every
@@ -1076,8 +1160,7 @@ class StreamingGBDT:
                 if step >= len(iters[ri]):
                     continue
                 b, lo, hi = iters[ri][step]
-                bins_blk = self._put(
-                    self._pad_block(self.binned, lo, hi), rk["dev"])
+                bins_blk = self._next_bins(ri, b, lo, hi)
                 off = np.int32(rk["goff"] + (lo - rk["lo"]))
                 leaf_new, h_blk = self._sweep(
                     bins_blk, self._score_dev[ri][b],
@@ -1089,7 +1172,7 @@ class StreamingGBDT:
                 hists[ri] = (h_blk if hists[ri] is None
                              else hists[ri] + h_blk)
                 blocks += 1
-                # throttle + free with a per-rank 2-block in-flight
+                # throttle + free with the per-rank 2-block in-flight
                 # window: unthrottled async dispatch would enqueue
                 # EVERY block's ~256 MB device buffer before the
                 # device drains one — at 128 blocks that is ~34 GB of
@@ -1097,14 +1180,15 @@ class StreamingGBDT:
                 # proof shape). Blocking on the rank's PREVIOUS block
                 # keeps upload of block s+1 overlapped with compute of
                 # block s while bounding transients to ~512 MB/rank.
-                if prev[ri] is not None:
-                    jax.block_until_ready(prev[ri][1])
-                    prev[ri][0].delete()
-                prev[ri] = (bins_blk, hists[ri])
-        for ri in range(n_ranks):
-            if prev[ri] is not None:
-                jax.block_until_ready(prev[ri][1])
-                prev[ri][0].delete()
+                self._inflight[ri].push((bins_blk, hists[ri]))
+        if not self._overlap:
+            # synchronous mode: the historic pre-reduce barrier. Under
+            # overlap the tail items stay pending — the find program's
+            # own result pull waits on them through data dependencies,
+            # so the collective dispatches WITHOUT a host sync and the
+            # leftover bins uploads are freed by the next sweep's
+            # pushes (<= depth block buffers per rank carry over).
+            self._drain_inflight()
         self.comm_stats["blocks_scanned"] += blocks
         if obs.enabled():
             obs.inc("stream.blocks_scanned", blocks)
@@ -1112,7 +1196,14 @@ class StreamingGBDT:
 
     def _find_level(self, hists, allowed_dev, eu, scale):
         """The ONE per-level collective + split search: returns the
-        packed [K_pad, 13] host array (identical on every rank)."""
+        packed [K_pad, 13] host array (identical on every rank).
+
+        Under ``tpu_stream_overlap`` this is called with the level's
+        tail sweeps still in flight: the collective program dispatches
+        immediately (async, ordered behind the accumulations by data
+        dependency) and the host blocks only on the packed result
+        pull — the reduce overlaps the tail sweeps and the next
+        blocks' staging instead of waiting for a host-side barrier."""
         from .. import obs
         self.comm_stats["levels"] += 1
         if self.R == 1:
@@ -1260,7 +1351,6 @@ class StreamingGBDT:
             leaf_out_dev.append(self._put(leaf_out, rk["dev"]))
         maxs = [None] * n_ranks
         counts = [None] * n_ranks
-        prev = [None] * n_ranks
         iters = [list(self._rank_blocks(ri)) for ri in range(n_ranks)]
         blocks = 0
         # block-step-major like _level_hists: keep every local device
@@ -1270,8 +1360,7 @@ class StreamingGBDT:
                 if step >= len(iters[ri]):
                     continue
                 b, lo, hi = iters[ri][step]
-                bins_blk = self._put(
-                    self._pad_block(self.binned, lo, hi), rk["dev"])
+                bins_blk = self._next_bins(ri, b, lo, hi)
                 leaf_new, score_new, m_blk, c_blk = self._final(
                     bins_blk, self._score_dev[ri][b],
                     self._label_dev[ri][b], self._weight_dev[ri][b],
@@ -1287,14 +1376,21 @@ class StreamingGBDT:
                                 else jnp.maximum(maxs[ri], m_blk))
                     counts[ri] = (c_blk if counts[ri] is None
                                   else counts[ri] + c_blk)
-                if prev[ri] is not None:
-                    jax.block_until_ready(prev[ri][1])
-                    prev[ri][0].delete()
-                prev[ri] = (bins_blk, score_new)
-        for ri in range(n_ranks):
-            if prev[ri] is not None:
-                jax.block_until_ready(prev[ri][1])
-                prev[ri][0].delete()
+                self._inflight[ri].push((bins_blk, score_new))
+        if not self._overlap:
+            # synchronous mode: complete the round before returning.
+            # Under overlap the final sweep's tail DEFERS — the next
+            # round's first level-sweep pushes complete it (its sweeps
+            # read score_new, so device data dependencies order the
+            # two rounds; the host never stalls between them). The
+            # next reader either blocks through a data dependency
+            # (eval_set / _collect_stats pulls) or drains explicitly
+            # (export_train_state — the PR 13 checkpoint contract).
+            # Note GOSS/quantized configs host-block at the next
+            # round's _collect_stats anyway (the sampling scalars need
+            # the folded stats), which bounds how much of the final
+            # sweep those configs can actually hide.
+            self._drain_inflight()
         self.comm_stats["blocks_scanned"] += blocks
         if obs.enabled():
             obs.inc("stream.blocks_scanned", blocks)
@@ -1346,6 +1442,12 @@ class StreamingGBDT:
         }
 
     def export_train_state(self) -> Dict:
+        # the PR 13 contract under tpu_stream_overlap: a deferred
+        # final sweep may still be in flight at a round boundary —
+        # drain it (block on the sweep outputs, free the uploads) so
+        # the np.asarray score pulls below export fully materialized
+        # slots, never a snapshot raced against pending updates
+        self._drain_inflight()
         state = {
             "engine": type(self).__name__,
             "iteration": int(self.iter_),
@@ -1396,6 +1498,10 @@ class StreamingGBDT:
         the exact-f32 path, and a hard error naming what moved for
         genuinely incompatible state (different data, engine, or tree
         count). Returns True."""
+        # a fresh engine's windows are empty, but adopting state into
+        # a live one must not leave stale sweeps pending against the
+        # slots being replaced
+        self._drain_inflight()
         saved_engine = state.get("engine")
         if saved_engine is not None \
                 and saved_engine != type(self).__name__:

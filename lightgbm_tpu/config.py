@@ -364,6 +364,18 @@ _PARAMS: Dict[str, Tuple[str, Any, Tuple[str, ...], Optional[Tuple[float, float]
     # applies per RANK under sharded streaming — a rank whose row
     # range would yield zero blocks fatals at construction
     "tpu_stream_block_rows": _P("int", 0),
+    # communication/compute overlap on the streamed hot path
+    # (docs/perf.md "Communication/compute overlap"): "auto"/"true"
+    # stages the next block's host->device upload on a worker thread
+    # while the device sweeps the current one, dispatches the
+    # per-level histogram collective without a blocking host sync,
+    # and lets the round-end score sweep drain behind the next
+    # round's first level sweep; "false" restores fully synchronous
+    # per-block dispatch (the A/B arm). Bit-identical either way BY
+    # CONSTRUCTION — accumulation order, reduce payloads and score
+    # arithmetic are unchanged; only where the HOST blocks moves.
+    # Checkpoint exports drain pending updates first in both modes.
+    "tpu_stream_overlap": _P("str", "auto"),
     # quantized-histogram collective wire: pack each (g,h) level-sum
     # pair into one int32 (g high 16 bits, h low 16) so the psum /
     # psum_scatter payload drops to 2/3 (docs/perf.md packed-wire
@@ -749,6 +761,8 @@ class Config:
                       f"(expected 'pool' or 'rebuild')")
         self.tpu_streaming = coerce_tristate(self.tpu_streaming,
                                              "tpu_streaming")
+        self.tpu_stream_overlap = coerce_tristate(self.tpu_stream_overlap,
+                                                  "tpu_stream_overlap")
         self.tpu_donate = coerce_tristate(self.tpu_donate, "tpu_donate")
         self.tpu_ingest_device = coerce_tristate(self.tpu_ingest_device,
                                                  "tpu_ingest_device")

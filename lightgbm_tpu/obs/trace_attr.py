@@ -15,9 +15,12 @@ promotes that parse into the obs plane proper:
   num_occurrences``, ``XEventMetadata.id/name``;
 - per-op busy aggregation over the device plane's "XLA Ops" line,
   the ``%copy`` share (the loop-state-copy signal the donation pass
-  exists to squeeze), and the per-iteration wall-vs-busy gap;
+  exists to squeeze), the collective share (the all-reduce busy the
+  ``tpu_stream_overlap`` pipeline hides behind compute), and the
+  per-iteration wall-vs-busy gap;
 - :func:`profile_gauges` feeds the result into the metrics registry as
-  ``train.copy_share`` / ``train.wall_busy_gap_ms`` — the same obs
+  ``train.copy_share`` / ``train.comm_share`` /
+  ``train.wall_busy_gap_ms`` — the same obs
   plane scripts/check.sh snapshots and scripts/obs_trend.py guards, so
   a ``%copy`` regression fails CI like an iters/sec regression does.
 
@@ -39,6 +42,17 @@ __all__ = ["parse_xspace", "aggregate_ops", "attribute",
 # names like "copy.1234", "%copy", "copy-start.5"/"copy-done.5" (async
 # copy pairs) — matched on the base name before the ".N" suffix
 _COPY_BASES = ("copy", "copy-start", "copy-done")
+
+# ops counted as cross-device communication in the comm share metric:
+# the collectives the sharded trainer/predictor can emit (sync forms
+# plus the async -start/-done pairs XLA splits them into). comm_share
+# is the number the tpu_stream_overlap pipeline moves: overlapped
+# collectives show the same comm busy but a smaller wall-vs-busy gap.
+_COMM_BASES = ("all-reduce", "all-reduce-start", "all-reduce-done",
+               "reduce-scatter", "all-gather", "all-gather-start",
+               "all-gather-done", "collective-permute",
+               "collective-permute-start", "collective-permute-done",
+               "all-to-all")
 
 
 # ---------------------------------------------------------------------------
@@ -195,11 +209,14 @@ def aggregate_ops(planes: List[Dict[str, Any]]
     busy_ps = sum(v[0] for v in ops.values())
     copy_ps = sum(v[0] for name, v in ops.items()
                   if _base_op(name) in _COPY_BASES)
+    comm_ps = sum(v[0] for name, v in ops.items()
+                  if _base_op(name) in _COMM_BASES)
     return {
         "device_plane": plane["name"],
         "ops": ops,                              # name -> [ps, calls]
         "busy_ps": busy_ps,
         "copy_ps": copy_ps,
+        "comm_ps": comm_ps,
         "window_ps": (t1 - t0) if t0 is not None else 0,
     }
 
@@ -235,8 +252,8 @@ def attribute(path: str, iters: Optional[int] = None,
     Returns a dict with ``found`` False (and ``reason``) when there is
     nothing to attribute; else ``ops`` (sorted descending by time,
     each ``{name, ms, calls, share}``), ``busy_ms``, ``wall_ms``,
-    ``copy_ms``, ``copy_share`` and — with ``iters`` —
-    ``wall_busy_gap_ms`` per iteration.
+    ``copy_ms``, ``copy_share``, ``comm_ms``, ``comm_share`` and —
+    with ``iters`` — ``wall_busy_gap_ms`` per iteration.
     """
     f = newest_xplane(path)
     if f is None:
@@ -262,6 +279,9 @@ def attribute(path: str, iters: Optional[int] = None,
         "copy_ms": agg["copy_ps"] / 1e9,
         "copy_share": (agg["copy_ps"] / agg["busy_ps"]
                        if agg["busy_ps"] else 0.0),
+        "comm_ms": agg["comm_ps"] / 1e9,
+        "comm_share": (agg["comm_ps"] / agg["busy_ps"]
+                       if agg["busy_ps"] else 0.0),
         "ops": [
             {"name": name, "ms": ps / 1e9, "calls": calls,
              "share": (ps / agg["busy_ps"] if agg["busy_ps"] else 0.0)}
@@ -278,8 +298,10 @@ def profile_gauges(profile_dir: str, iters: Optional[int] = None,
                    wall_ms: Optional[float] = None) -> Dict[str, Any]:
     """Attribute a finished ``tpu_profile_dir`` dump into the metrics
     registry: ``train.copy_share`` (fraction of device busy spent in
-    copy ops) and — when ``iters`` is known — ``train.wall_busy_gap_ms``
-    (per-iteration wall-vs-busy gap). Forced gauges: asking for a
+    copy ops), ``train.comm_share`` (fraction spent in cross-device
+    collectives) and — when ``iters`` is known —
+    ``train.wall_busy_gap_ms`` (per-iteration wall-vs-busy gap).
+    Forced gauges: asking for a
     profiler trace IS opting into its attribution, tpu_metrics or not.
     Never raises — a malformed dump warns and returns the reason; the
     training/bench run that produced it must not fail on telemetry."""
@@ -295,6 +317,7 @@ def profile_gauges(profile_dir: str, iters: Optional[int] = None,
         return res
     from . import set_gauge
     set_gauge("train.copy_share", float(res["copy_share"]), force=True)
+    set_gauge("train.comm_share", float(res["comm_share"]), force=True)
     if "wall_busy_gap_ms" in res:
         set_gauge("train.wall_busy_gap_ms",
                   float(res["wall_busy_gap_ms"]), force=True)

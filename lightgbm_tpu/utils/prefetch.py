@@ -1,0 +1,154 @@
+"""Shared host<->device pipelining primitives (the two overlap idioms).
+
+Two places in the codebase overlap transfers with device compute, and
+before this module they each hand-rolled the same bookkeeping:
+
+- predict's chunked traversal (gbdt._run_forest_chunks, PR 7) issues
+  ``copy_to_host_async`` on chunk *i*'s output before dispatching
+  chunk *i+1*, draining the oldest result once two are in flight;
+- the streamed trainer (boosting/streaming.py) uploads bins block
+  *i+1* while the device sweeps block *i*, blocking on the PREVIOUS
+  block's sweep output before deleting its bins upload.
+
+Both are the same structure — a depth-bounded in-flight window — so it
+lives here once (:class:`InflightWindow`), and the upload direction
+gains a one-step-lookahead staging thread (:class:`BlockPrefetcher`)
+so the ``device_put`` of the NEXT block (host-side slice + pad + wire
+transfer) runs concurrently with the current block's dispatch instead
+of serializing in front of it.
+
+THREADING CONTRACT: the staging callable handed to
+:class:`BlockPrefetcher` runs on a background worker thread. It must
+only *stage data* (slice/pad/``jax.device_put``) — it must NEVER
+dispatch a cross-device collective (or anything that reaches one): on
+a gang, per-rank collective launch order would then be a
+thread-scheduling accident and the ranks deadlock. The
+``tools/analyze`` collective-safety checker enforces this statically
+(the ``thread:`` finding class).
+"""
+from __future__ import annotations
+
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+__all__ = ["InflightWindow", "BlockPrefetcher"]
+
+
+class InflightWindow:
+    """Depth-bounded in-flight completion window.
+
+    ``push(item)`` appends ``item`` and then completes (oldest-first)
+    until at most ``depth`` items remain pending — so at the moment of
+    a push, ``depth + 1`` items are briefly in flight: the one just
+    dispatched plus the retained tail. ``depth=1`` is the classic
+    double buffer both call sites used. ``drain()`` completes
+    everything (the checkpoint-export / end-of-plan barrier).
+
+    ``complete`` receives one pushed item and is where the caller
+    blocks on device work and frees transient buffers
+    (``jax.block_until_ready`` + ``.delete()`` on the trainer path,
+    ``np.asarray`` of an async D2H copy on the predict path).
+    """
+
+    def __init__(self, depth: int, complete: Callable[[Any], None]):
+        self.depth = max(0, int(depth))
+        self._complete = complete
+        self._q: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, item: Any) -> None:
+        self._q.append(item)
+        while len(self._q) > self.depth:
+            self._complete(self._q.popleft())
+
+    def drain(self) -> None:
+        while self._q:
+            self._complete(self._q.popleft())
+
+
+class BlockPrefetcher:
+    """One-ahead staging of a cyclic upload schedule.
+
+    The streamed trainer's sweeps (every level sweep, the final sweep,
+    and then the next round's sweeps) all iterate the IDENTICAL
+    step-major ``(rank, block)`` schedule — so a single cyclic
+    prefetcher never stages a block that will not be consumed: items
+    staged past one sweep's end are exactly the next sweep's first
+    items. Only at the very end of training do up to ``lookahead + 1``
+    staged uploads go unconsumed (bounded, block-sized transients).
+
+    ``take(expect=...)`` returns the staged result for the next
+    schedule item, keeping ``lookahead`` further stage calls running
+    on the worker thread; ``expect`` pins the consumer's iteration
+    order to the schedule — any drift is a loud error, not a silently
+    wrong block. With ``threaded=False`` the stage callable runs
+    inline on the caller's thread at ``take`` time — bit-for-bit the
+    pre-pipelining dispatch order (the ``tpu_stream_overlap=false``
+    arm), with the same loud schedule check.
+
+    See the module docstring for the staging-thread contract: ``stage``
+    must only slice/pad/``device_put`` — never reach a collective.
+    """
+
+    def __init__(self, stage: Callable[[Any], Any],
+                 schedule: Iterable[Any], lookahead: int = 1,
+                 threaded: bool = True):
+        self._stage = stage
+        self._schedule: Sequence[Any] = list(schedule)
+        if not self._schedule:
+            raise ValueError("BlockPrefetcher needs a non-empty "
+                             "schedule")
+        self._look = max(1, int(lookahead))
+        self._pos = 0
+        self._pending: deque = deque()   # (item, future)
+        self._pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=1,
+                               thread_name_prefix="h2d-prefetch")
+            if threaded else None)
+
+    def _next_item(self) -> Any:
+        item = self._schedule[self._pos % len(self._schedule)]
+        self._pos += 1
+        return item
+
+    def take(self, expect: Any = None) -> Any:
+        if self._pool is None:
+            item = self._next_item()
+            if expect is not None and item != expect:
+                raise RuntimeError(
+                    f"BlockPrefetcher schedule drift: consumer asked "
+                    f"for {expect!r} but the schedule yields {item!r}")
+            return self._stage(item)
+        while len(self._pending) <= self._look:
+            item = self._next_item()
+            self._pending.append(
+                (item, self._pool.submit(self._stage, item)))
+        item, fut = self._pending.popleft()
+        if expect is not None and item != expect:
+            raise RuntimeError(
+                f"BlockPrefetcher schedule drift: consumer asked for "
+                f"{expect!r} but the schedule yields {item!r}")
+        return fut.result()
+
+    def close(self) -> None:
+        """Cancel/free staged-but-unconsumed work and stop the worker.
+        Staged device buffers are ``.delete()``d when they expose it
+        (jax arrays do) so end-of-training leftovers do not pin HBM."""
+        while self._pending:
+            _item, fut = self._pending.popleft()
+            if not fut.cancel():
+                try:
+                    res = fut.result()
+                except Exception:
+                    continue
+                if hasattr(res, "delete"):
+                    try:
+                        res.delete()
+                    except Exception:
+                        pass
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None

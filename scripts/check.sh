@@ -191,6 +191,11 @@ print("obs " + json.dumps({
     # --profile-dir; obs_trend.py fails on it regressing above its
     # trailing median like iters/sec
     "copy_share": gauge("train.copy_share"),
+    # collective share of device busy (same trace attribution) and the
+    # per-iter wall-vs-busy gap the tpu_stream_overlap pipeline
+    # shrinks; obs_trend.py guards the gap like copy_share
+    "comm_share": gauge("train.comm_share"),
+    "wall_busy_gap_ms": gauge("train.wall_busy_gap_ms"),
     # streamed-training trajectory + the sharded-streaming dryrun pin
     "stream_rows_per_sec": gauge("bench.stream_rows_per_sec"),
     "stream_shards": gauge("bench.stream_shards"),

@@ -20,6 +20,13 @@ regressed past its threshold —
   "Iteration floor") UP by more than ``--max-copy-up`` (fraction)
   plus ``--copy-slack`` absolute (the share sits near zero once
   donation lands; a pure ratio would flag noise);
+- ``wall_busy_gap_ms`` (the per-iteration wall-vs-device-busy gap from
+  trace attribution — the dispatch/collective stall residue the
+  ``tpu_stream_overlap`` pipeline hides; docs/perf.md "Communication/
+  compute overlap") UP by more than ``--max-gap-up`` (fraction) plus
+  ``--gap-slack-ms`` absolute — the copy_share guard's shape: the gap
+  sits near zero once overlap lands, so a pure ratio would flag timer
+  noise while a pure absolute would miss a doubling;
 - ``queue_wait_p99_ms`` (the serving smoke's windowed queue-wait p99,
   docs/observability.md "Request tracing") UP by more than
   ``--max-qw-up`` (fraction) plus ``--qw-slack-ms`` absolute — the
@@ -70,6 +77,7 @@ Usage (scripts/check.sh runs it behind CHECK_TREND=1):
         [--window 5] [--max-ips-drop 0.15] [--max-compile-up 0.5]
         [--compile-slack 2] [--max-hbm-up 0.2] [--max-secs-up 0.35]
         [--max-copy-up 0.5] [--copy-slack 0.005]
+        [--max-gap-up 0.5] [--gap-slack-ms 3.0]
         [--max-qw-up 0.5] [--qw-slack-ms 2.0]
 Exit codes: 0 = no regression (or no history), 1 = regression, 2 = bad
 invocation (unreadable log path given explicitly).
@@ -143,7 +151,8 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
                 compile_slack: float, max_hbm_up: float,
                 max_secs_up: float, max_copy_up: float = 0.5,
                 copy_slack: float = 0.005, max_qw_up: float = 0.5,
-                qw_slack_ms: float = 2.0) -> List[str]:
+                qw_slack_ms: float = 2.0, max_gap_up: float = 0.5,
+                gap_slack_ms: float = 3.0) -> List[str]:
     """Regression messages for the newest entry vs the trailing median
     of up to ``window`` earlier same-mode entries; [] = green."""
     if not entries:
@@ -249,6 +258,19 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
                 f"gate dropped a carry (docs/perf.md 'Iteration "
                 f"floor')")
 
+    gap_now = _num(newest, "wall_busy_gap_ms")
+    gap_med = _median_of(history, "wall_busy_gap_ms")
+    if gap_now is not None and gap_med is not None:
+        ceil = gap_med * (1.0 + max_gap_up) + gap_slack_ms
+        if gap_now > ceil:
+            failures.append(
+                f"wall_busy_gap_ms regressed: {gap_now:.3g} > "
+                f"{ceil:.3g} (trailing median {gap_med:.3g} over "
+                f"{len(history)} run(s)): the per-iter wall-vs-busy "
+                f"gap crept back — a host sync snuck into the "
+                f"overlapped stream path (docs/perf.md "
+                f"'Communication/compute overlap')")
+
     qw_now = _num(newest, "queue_wait_p99_ms")
     qw_med = _median_of(history, "queue_wait_p99_ms")
     if qw_now is not None and qw_med is not None:
@@ -304,6 +326,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="absolute copy_share headroom on top of the "
                          "ratio (the share sits near zero once "
                          "donation lands)")
+    ap.add_argument("--max-gap-up", type=float, default=0.5)
+    ap.add_argument("--gap-slack-ms", type=float, default=3.0,
+                    help="absolute wall_busy_gap_ms headroom on top "
+                         "of the ratio (the gap sits near zero once "
+                         "overlap lands; pure ratios would flag "
+                         "host-timer noise)")
     ap.add_argument("--max-qw-up", type=float, default=0.5)
     ap.add_argument("--qw-slack-ms", type=float, default=2.0,
                     help="absolute queue_wait_p99_ms headroom on top "
@@ -334,7 +362,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                            args.max_compile_up, args.compile_slack,
                            args.max_hbm_up, args.max_secs_up,
                            args.max_copy_up, args.copy_slack,
-                           args.max_qw_up, args.qw_slack_ms)
+                           args.max_qw_up, args.qw_slack_ms,
+                           args.max_gap_up, args.gap_slack_ms)
     if failures:
         for msg in failures:
             print(f"obs_trend: REGRESSION — {msg}")

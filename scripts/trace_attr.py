@@ -70,6 +70,8 @@ def main(argv=None) -> int:
     print(f"{'device busy':<44} {res['busy_ms']:>10.3f}")
     print(f"{'%copy (loop-state copies)':<44} {res['copy_ms']:>10.3f} "
           f"{'':>8} {res['copy_share']:>6.1%}")
+    print(f"{'collectives (all-reduce et al.)':<44} "
+          f"{res['comm_ms']:>10.3f} {'':>8} {res['comm_share']:>6.1%}")
     print(f"{'wall (traced window)':<44} {res['wall_ms']:>10.3f}")
     if "wall_busy_gap_ms" in res:
         print(f"wall-vs-busy gap: {res['wall_busy_gap_ms']:.2f} ms/iter "

@@ -215,6 +215,55 @@ def test_collective_safety_flags_rank_divergent_conditional():
         run_checker_on_source("collective-safety", src2))
 
 
+def test_collective_safety_flags_thread_dispatched_collective():
+    """The ISSUE 17 staging contract: a callable handed to a
+    background thread (executor.submit / Thread(target=) / a
+    BlockPrefetcher staging slot) must not reach a collective —
+    per-rank launch order would become a thread-scheduling accident
+    (gang deadlock). Bound-method references (`self._stage`) resolve
+    by attr name like the module-local call graph does."""
+    src = (
+        "import jax\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "import threading\n"
+        "from lightgbm_tpu.utils.prefetch import BlockPrefetcher\n"
+        "def _reduce(x):\n"
+        "    return jax.lax.psum(x, 'd')\n"
+        "def _stage(x):\n"
+        "    return _reduce(x)       # transitive reach\n"
+        "def f(pool, x):\n"
+        "    return pool.submit(_reduce, x)\n"
+        "def g(x):\n"
+        "    t = threading.Thread(target=_stage, args=(x,))\n"
+        "    t.start()\n"
+        "class Eng:\n"
+        "    def _stage(self, x):\n"
+        "        return _reduce(x)\n"
+        "    def h(self):\n"
+        "        return BlockPrefetcher(self._stage, [1, 2])\n")
+    ks = _keys(run_checker_on_source("collective-safety", src))
+    assert "thread:_reduce@f" in ks
+    assert "thread:_stage@g" in ks
+    assert "thread:_stage@h" in ks
+
+
+def test_collective_safety_passes_pure_staging_threads():
+    # the shape streaming.py actually uses: the staged callable only
+    # slices/pads/device_puts; the collective dispatches from the main
+    # thread after the window push
+    src = (
+        "import jax\n"
+        "from lightgbm_tpu.utils.prefetch import BlockPrefetcher\n"
+        "def _stage(item):\n"
+        "    return jax.device_put(item)\n"
+        "def f(pool, sched, x):\n"
+        "    pf = BlockPrefetcher(_stage, sched)\n"
+        "    pool.submit(_stage, x)\n"
+        "    h = pf.take()\n"
+        "    return jax.lax.psum(h, 'd')   # main thread: fine\n")
+    assert run_checker_on_source("collective-safety", src) == []
+
+
 def test_collective_safety_passes_hoisted_collectives():
     # the shape serial.py actually uses: branches histogram locally,
     # the reduction wraps the switch RESULT

@@ -137,6 +137,35 @@ def test_attribute_shares_and_gap(dump_dir):
     assert sum(op["share"] for op in res["ops"]) == pytest.approx(1.0)
 
 
+def test_comm_share_buckets_collectives(tmp_path):
+    """Collectives — sync forms and XLA's async -start/-done splits —
+    bucket into ``comm_share``; compute fusions do not, and the comm
+    and copy buckets stay disjoint."""
+    MS = 1_000_000_000
+    dev = _plane("/device:TPU:0", [
+        _line("XLA Ops", 0, [
+            _event(1, 0, 55 * MS),
+            _event(2, 55 * MS, 30 * MS),
+            _event(3, 85 * MS, 10 * MS),
+            _event(4, 95 * MS, 5 * MS),
+        ]),
+    ], [
+        _metadata_entry(1, "fusion.2"),
+        _metadata_entry(2, "%all-reduce.7"),
+        _metadata_entry(3, "all-reduce-start.9"),
+        _metadata_entry(4, "copy.11"),
+    ])
+    f = tmp_path / "comm.xplane.pb"
+    f.write_bytes(_field(1, dev))
+    res = attribute(str(f))
+    assert res["found"]
+    assert res["busy_ms"] == pytest.approx(100.0)
+    # all-reduce.7 + all-reduce-start.9; neither fusion nor copy
+    assert res["comm_ms"] == pytest.approx(40.0)
+    assert res["comm_share"] == pytest.approx(0.40)
+    assert res["copy_share"] == pytest.approx(0.05)
+
+
 def test_newest_xplane_picks_latest(tmp_path):
     d = tmp_path / "plugins" / "profile"
     d.mkdir(parents=True)
@@ -177,6 +206,9 @@ def test_profile_gauges_feed_obs_registry(dump_dir):
     vals = {m["name"]: m["value"] for m in snap["metrics"]
             if not m.get("labels")}
     assert vals["train.copy_share"] == pytest.approx(0.35)
+    # the synthetic dump has no collectives: comm_share feeds as 0,
+    # not as a missing gauge (obs_trend skips missing signals)
+    assert vals["train.comm_share"] == pytest.approx(0.0)
     assert vals["train.wall_busy_gap_ms"] == pytest.approx(5.0)
     # degradation feeds nothing and reports why
     missing = profile_gauges(os.path.join(dump_dir, "nope"))

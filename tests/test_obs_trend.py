@@ -158,6 +158,34 @@ def test_copy_share_regression_fails(tmp_path):
     assert rc == 0, out
 
 
+def test_wall_busy_gap_regression_fails(tmp_path):
+    """The overlap sentinel (docs/perf.md "Communication/compute
+    overlap"): the per-iter wall-vs-busy gap regressing past its
+    trailing median (ratio + absolute ms slack, the copy_share guard's
+    shape) fails — a host sync creeping back into the overlapped
+    stream path; jitter inside the slack and histories without the
+    signal stay green."""
+    def _with_gap(ms):
+        e = json.loads(_obs_line()[len("obs "):])
+        e["wall_busy_gap_ms"] = ms
+        return "obs " + json.dumps(e)
+
+    base = [_with_gap(4.0) for _ in range(4)]
+    # 4.0 * 1.5 + 3.0 = 9.0 ceiling: a gap jumping past double (a
+    # blocking sync snuck back between sweep and reduce) must fail
+    rc, out = _run(tmp_path, base + [_with_gap(12.0)])
+    assert rc == 1 and "wall_busy_gap_ms regressed" in out
+    # within ratio+slack stays green (host-timer jitter near zero)
+    rc, out = _run(tmp_path, base + [_with_gap(6.0)])
+    assert rc == 0, out
+    # signal absent on either side -> skipped (pre-overlap logs)
+    rc, out = _run(tmp_path, base + [_obs_line()])
+    assert rc == 0, out
+    rc, out = _run(tmp_path, [_obs_line() for _ in range(4)]
+                   + [_with_gap(12.0)])
+    assert rc == 0, out
+
+
 def test_queue_wait_p99_regression_fails(tmp_path):
     """The serving queue-pressure sentinel (docs/observability.md
     "Request tracing"): the smoke's windowed queue-wait p99 regressing

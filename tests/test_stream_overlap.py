@@ -1,0 +1,256 @@
+"""Async pipelined streamed×sharded training (ISSUE 17): block H2D
+prefetch, overlapped level reduce, deferred final sweep — all behind
+``tpu_stream_overlap``, bit-identical on/off BY CONSTRUCTION.
+
+The contract pinned here: overlap moves HOST BLOCKING only —
+accumulation order, reduce payloads, and score arithmetic are
+untouched — so models with ``tpu_stream_overlap`` on vs off are
+bit-identical at 1/2/4 shards × {plain, quantized, GOSS, bagging};
+the one-collective-per-level invariant (``comm.allreduce_calls ==
+levels``) survives the async dispatch; checkpoint exports drain the
+in-flight windows first (the PR 13 contract), so a streamed resume and
+an elastic re-cut taken while a final sweep was pending stay
+bit-exact; and the utils/prefetch.py primitives (the shared window /
+prefetcher the trainer and predict both ride) keep their ordering,
+drain, and loud-schedule-drift semantics.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.prefetch import BlockPrefetcher, InflightWindow
+
+
+def _data(n=6_000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+# same shape family as tests/test_streaming_resume.py BASE so the
+# modules share jit compiles (block 2048, leaves 16, depth 4)
+BASE = {"objective": "binary", "num_leaves": 16, "max_depth": 4,
+        "verbosity": -1, "min_data_in_leaf": 20,
+        "tpu_streaming": "true", "tpu_stream_block_rows": 2_048}
+
+
+def _params(shards, overlap, **extra):
+    p = dict(BASE, tpu_stream_overlap="true" if overlap else "false",
+             **extra)
+    if shards > 1:
+        p["tree_learner"] = "data"
+        p["tpu_mesh_shape"] = shards
+    return p
+
+
+def _train(shards, overlap, X, y, rounds=3, **extra):
+    return lgb.train(_params(shards, overlap, **extra),
+                     lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance matrix: overlap on == overlap off, bit for bit
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("shards", [1, 2, 4])
+@pytest.mark.parametrize("extra", [
+    {},
+    {"use_quantized_grad": True},
+    {"data_sample_strategy": "goss"},
+    {"bagging_fraction": 0.6, "bagging_freq": 2},
+], ids=["plain", "quant", "goss", "bagging"])
+def test_overlap_bit_identical(extra, shards):
+    X, y = _data()
+    off = _train(shards, False, X, y, **extra)
+    on = _train(shards, True, X, y, **extra)
+    assert on.model_to_string() == off.model_to_string(), \
+        f"tpu_stream_overlap changed the model at {shards} shard(s)"
+
+
+def test_one_collective_per_level_under_overlap():
+    """The overlapped reduce must not split, repeat, or drop the
+    per-level packed collective: exactly ONE allreduce per grown
+    level, same as the synchronous path."""
+    X, y = _data()
+    on = _train(2, True, X, y)
+    off = _train(2, False, X, y)
+    cs_on, cs_off = on.engine.comm_stats, off.engine.comm_stats
+    assert cs_on["levels"] > 0
+    assert cs_on["allreduce_calls"] == cs_on["levels"]
+    assert (cs_on["allreduce_calls"], cs_on["allreduce_bytes"]) == \
+        (cs_off["allreduce_calls"], cs_off["allreduce_bytes"])
+
+
+def test_overlap_defaults_on_and_rejects_garbage():
+    X, y = _data(n=4_000)
+    bst = lgb.train(dict(BASE), lgb.Dataset(X, label=y),
+                    num_boost_round=1)
+    assert bst.engine._overlap          # auto == on
+    eng_off = _train(1, False, X, y, rounds=1).engine
+    assert not eng_off._overlap
+    with pytest.raises(lgb.LightGBMError, match="tpu_stream_overlap"):
+        lgb.train(dict(BASE, tpu_stream_overlap="sideways"),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint contract: export drains pending device work first
+# ---------------------------------------------------------------------------
+def test_export_drains_pending_final_sweep():
+    """After a round the deferred final sweep is still in flight (the
+    windows deliberately hold work across the round seam); exporting
+    train state must drain every window first — a checkpoint is a
+    barrier, not a snapshot of a moving target."""
+    X, y = _data()
+    eng = _train(1, True, X, y).engine
+    assert any(len(w) for w in eng._inflight), \
+        "expected a pending deferred final sweep under overlap"
+    state = eng.export_train_state()
+    assert all(len(w) == 0 for w in eng._inflight)
+    assert state["iteration"] == 3
+
+
+@pytest.mark.parametrize("extra", [
+    {},
+    {"use_quantized_grad": True},
+], ids=["plain", "quant"])
+def test_streamed_resume_across_overlap_modes(extra, tmp_path):
+    """Checkpoints written while final sweeps were pending (interval 2,
+    kill before iter 3) resume bit-equal — and the straight arm runs
+    overlap OFF while the chaos+resume arms run overlap ON, so the
+    equality also crosses the modes."""
+    X, y = _data(n=8_000)
+    rounds, kill_at = 5, 3
+    straight = lgb.train(
+        _params(2, False, checkpoint_dir=str(tmp_path / "s"),
+                checkpoint_interval=2, **extra),
+        lgb.Dataset(X, label=y), num_boost_round=rounds)
+    p = _params(2, True, checkpoint_dir=str(tmp_path / "c"),
+                checkpoint_interval=2,
+                tpu_fault_inject=f"exn:iter={kill_at}", **extra)
+    with pytest.raises(lgb.LightGBMError, match="injected failure"):
+        lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    resumed = lgb.train(p, lgb.Dataset(X, label=y),
+                        num_boost_round=rounds,
+                        resume_from=str(tmp_path / "c"))
+    assert resumed.num_trees() == rounds
+    assert resumed.model_to_string() == straight.model_to_string()
+
+
+def test_elastic_recut_with_overlap(tmp_path):
+    """PR 18's topology re-cut on top of the pipeline: a 4-shard
+    overlapped run killed mid-training resumes at 2 shards (scores
+    re-cut via _replay_score_blocks) still overlapped, bit-equal to
+    the uninterrupted synchronous 4-shard run."""
+    X, y = _data(n=8_000)
+    extra = {"use_quantized_grad": True}    # quant makes re-cut exact
+    rounds, kill_at = 5, 3
+    straight = lgb.train(
+        _params(4, False, checkpoint_dir=str(tmp_path / "s"),
+                checkpoint_interval=2, **extra),
+        lgb.Dataset(X, label=y), num_boost_round=rounds)
+    with pytest.raises(lgb.LightGBMError, match="injected failure"):
+        lgb.train(_params(4, True, checkpoint_dir=str(tmp_path / "c"),
+                          checkpoint_interval=2,
+                          tpu_fault_inject=f"exn:iter={kill_at}",
+                          **extra),
+                  lgb.Dataset(X, label=y), num_boost_round=rounds)
+    resumed = lgb.train(_params(2, True,
+                                checkpoint_dir=str(tmp_path / "c"),
+                                checkpoint_interval=2, **extra),
+                        lgb.Dataset(X, label=y), num_boost_round=rounds,
+                        resume_from=str(tmp_path / "c"))
+    assert resumed.model_to_string() == straight.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# utils/prefetch.py primitives
+# ---------------------------------------------------------------------------
+def test_inflight_window_order_depth_drain():
+    done = []
+    win = InflightWindow(1, done.append)
+    win.push("a")
+    assert done == [] and len(win) == 1     # depth 1: nothing completes
+    win.push("b")
+    assert done == ["a"]                    # oldest-first
+    win.push("c")
+    assert done == ["a", "b"]
+    win.drain()
+    assert done == ["a", "b", "c"] and len(win) == 0
+    win.drain()                             # idempotent
+    assert done == ["a", "b", "c"]
+
+
+def test_inflight_window_depth_zero_is_synchronous():
+    done = []
+    win = InflightWindow(0, done.append)
+    win.push("a")
+    assert done == ["a"]                    # completes at every push
+
+
+@pytest.mark.parametrize("threaded", [False, True],
+                         ids=["inline", "threaded"])
+def test_prefetcher_cyclic_order_and_drift(threaded):
+    staged = []
+
+    def stage(item):
+        staged.append(item)
+        return item * 10
+
+    pf = BlockPrefetcher(stage, [1, 2, 3], threaded=threaded)
+    try:
+        # two full cycles: the schedule wraps (next sweep = same order)
+        got = [pf.take(expect=e) for e in (1, 2, 3, 1, 2, 3)]
+        assert got == [10, 20, 30, 10, 20, 30]
+        # consumer iterating out of schedule order is a loud error,
+        # not a silently wrong block
+        with pytest.raises(RuntimeError, match="schedule drift"):
+            pf.take(expect=3)
+    finally:
+        pf.close()
+
+
+def test_prefetcher_threaded_stages_ahead():
+    import threading
+    names = []
+
+    def stage(item):
+        names.append(threading.current_thread().name)
+        return item
+
+    pf = BlockPrefetcher(stage, ["x", "y"], threaded=True)
+    try:
+        assert pf.take(expect="x") == "x"
+        assert all(n.startswith("h2d-prefetch") for n in names)
+    finally:
+        pf.close()
+
+
+def test_prefetcher_close_frees_staged_buffers():
+    class Buf:
+        def __init__(self):
+            self.deleted = False
+
+        def delete(self):
+            self.deleted = True
+
+    bufs = []
+
+    def stage(_item):
+        b = Buf()
+        bufs.append(b)
+        return b
+
+    pf = BlockPrefetcher(stage, [1, 2, 3], threaded=True)
+    pf.take(expect=1)
+    pf.close()
+    # everything staged-but-unconsumed was freed; the consumed buffer
+    # is the caller's to manage
+    assert all(b.deleted for b in bufs[1:])
+    assert not bufs[0].deleted
+
+
+def test_prefetcher_rejects_empty_schedule():
+    with pytest.raises(ValueError, match="non-empty"):
+        BlockPrefetcher(lambda x: x, [])

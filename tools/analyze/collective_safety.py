@@ -13,6 +13,14 @@ switch): cross-device collectives (``psum`` / ``psum_scatter`` /
 - rank-divergent Python conditionals: ``if process_index() == 0: ...``
   (or an ``if`` over a ``rank``-named value) around a collective
   diverges the gang at trace time.
+- background-thread dispatch: a callable handed to
+  ``executor.submit(...)``, ``Thread(target=...)`` or a
+  ``BlockPrefetcher`` staging slot (utils/prefetch.py) runs off the
+  main thread — if it reaches a collective, per-rank collective launch
+  order becomes a thread-scheduling accident and the gang deadlocks
+  exactly like the branch case. The ``tpu_stream_overlap`` pipeline's
+  staging contract ("slice/pad/device_put only, never a collective")
+  is this rule, enforced statically.
 
 Detection: per module, a call graph over locally-defined functions
 (including nested defs and lambdas) is fixpointed into the set of
@@ -28,7 +36,8 @@ therefore mesh-uniform by construction) lives in the allowlist with
 that reasoning spelled out.
 
 Keys: ``branch:<function>@<switch-site-function>``,
-``rank-if:<collective>@<enclosing-function>``.
+``rank-if:<collective>@<enclosing-function>``,
+``thread:<function>@<dispatch-site-function>``.
 """
 from __future__ import annotations
 
@@ -141,6 +150,26 @@ def _branch_refs(arg: ast.AST,
     return out
 
 
+def _thread_target_refs(arg: ast.AST) -> Set[str]:
+    """Function names referenced by one async-dispatch operand: the
+    first arg of ``submit``, the ``target=`` of ``Thread``, the stage
+    callable of ``BlockPrefetcher``. Bound methods reference by their
+    attr name (``self._stage_bins`` -> ``_stage_bins``) — module-local
+    defs register under bare names, so this matches the call graph."""
+    out: Set[str] = set()
+    if isinstance(arg, ast.Name):
+        out.add(arg.id)
+    elif isinstance(arg, ast.Attribute):
+        out.add(arg.attr)
+    elif isinstance(arg, ast.Lambda):
+        for n in ast.walk(arg.body):
+            if isinstance(n, ast.Call):
+                cn = call_name(n)
+                if cn:
+                    out.add(cn)
+    return out
+
+
 def _rank_divergent(test: ast.AST) -> Optional[str]:
     """Name evidence that an `if` test reads a rank identity."""
     for n in ast.walk(test):
@@ -208,6 +237,38 @@ class _ModuleChecker(ast.NodeVisitor):
                 cn = call_name(a)
                 if cn:
                     refs.add(cn)
+        # async dispatch: executor.submit(fn, ...) / Thread(target=fn)
+        # / BlockPrefetcher(stage, ...) — the handed callable runs on a
+        # background thread; reaching a collective there makes per-rank
+        # launch order a scheduling accident (gang deadlock)
+        dispatch_ops: List[ast.AST] = []
+        if fn_name == "submit" and node.args:
+            dispatch_ops.append(node.args[0])
+        elif fn_name == "Thread":
+            dispatch_ops.extend(kw.value for kw in node.keywords
+                                if kw.arg == "target")
+        elif fn_name == "BlockPrefetcher":
+            if node.args:
+                dispatch_ops.append(node.args[0])
+            dispatch_ops.extend(kw.value for kw in node.keywords
+                                if kw.arg == "stage")
+        for op in dispatch_ops:
+            for ref in sorted(_thread_target_refs(op)):
+                expanded = {ref} | (self.fns[ref].nested
+                                    if ref in self.fns else set())
+                if any(r in self.reaching or r in COLLECTIVES
+                       for r in expanded):
+                    self.findings.append(Finding(
+                        NAME, self.rel, node.lineno,
+                        f"thread:{ref}@{self.scope[-1]}",
+                        f"collective-reaching function `{ref}` is "
+                        f"dispatched to a background thread "
+                        f"(`{fn_name}`) in `{self.scope[-1]}` — "
+                        f"per-rank collective launch order becomes a "
+                        f"thread-scheduling accident and the gang "
+                        f"deadlocks; collectives must dispatch "
+                        f"gang-uniformly from the main thread "
+                        f"(utils/prefetch.py staging contract)"))
         if fn_name in ("switch", "cond"):
             branch_args = node.args[1:]
             for arg in branch_args:
