@@ -204,6 +204,7 @@ def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
     # after the warm call above — main config only, the continuity/
     # guard runs discard it
     predict_rps = None
+    shap_rps = None
     if measure_predict:
         n_pred = min(10_000, len(X_ho))
         eng.predict(X_ho[:n_pred])                # warm this bucket
@@ -213,6 +214,17 @@ def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
             eng.predict(X_ho[:n_pred])
             pred_rates.append(n_pred / (time.time() - t0))
         predict_rps = statistics.median(pred_rates)
+        # explain throughput (device SHAP: cached path tables + the
+        # same bucketed shapes; docs/perf.md "Device SHAP") — a small
+        # subset, SHAP programs are O(depth) heavier than predicts
+        n_shap = min(8_000, len(X_ho))
+        eng.predict_contrib(X_ho[:n_shap])        # tables + compile
+        shap_rates = []
+        for _ in range(3):
+            t0 = time.time()
+            eng.predict_contrib(X_ho[:n_shap])
+            shap_rates.append(n_shap / (time.time() - t0))
+        shap_rps = statistics.median(shap_rates)
     for _ in range(windows - 1):
         t0 = time.time()
         eng.train_chunk(iters)
@@ -222,7 +234,7 @@ def run_config(X, y, X_ho, y_ho, params, iters, warmup, windows=3,
     _obs.set_gauge("bench.hist_partition",
                    float(getattr(eng, "hist_partition", False)),
                    force=True)
-    return statistics.median(rates), auc, bin_time, predict_rps
+    return statistics.median(rates), auc, bin_time, predict_rps, shap_rps
 
 
 def main():
@@ -356,10 +368,8 @@ def main():
         obs.enable(metrics=True, slo=True)
         start_server(args.metrics_port)
 
-    ips, auc, bin_time, predict_rps = run_config(X, y, X_ho, y_ho,
-                                                 params, args.iters,
-                                                 args.warmup,
-                                                 args.windows)
+    ips, auc, bin_time, predict_rps, shap_rps = run_config(
+        X, y, X_ho, y_ho, params, args.iters, args.warmup, args.windows)
     # headline measurements become forced obs gauges, and the metric
     # line below reads them back from ONE snapshot — the snapshot is
     # the authority, the printed line a view of it (same keys as ever,
@@ -370,6 +380,7 @@ def main():
     obs.set_gauge("bench.engine_init_s", bin_time[1], force=True)
     obs.set_gauge("bench.ttfi_s", bin_time[2], force=True)
     obs.set_gauge("bench.predict_rps", predict_rps, force=True)
+    obs.set_gauge("bench.shap_rows_per_sec", shap_rps, force=True)
 
     # continuity figure: the rounds-1..3 headline config (higgs-1M,
     # plain full-row f32) timed in the same process on the main run's
@@ -382,7 +393,7 @@ def main():
               "verbosity": -1, "use_quantized_grad": False}
         # 40-iteration chunks: shorter ones fall below tpu_fuse_iters
         # and pay per-iteration dispatch (measured 2x slower)
-        ips1, auc1, _, _ = run_config(
+        ips1, auc1, _, _, _ = run_config(
             X[:n1], y[:n1], X_ho[:100_000], y_ho[:100_000], p1,
             40, 50, windows=3, measure_predict=False)
         obs.set_gauge("bench.plain1m_iters_per_sec", ips1, force=True)
@@ -393,7 +404,7 @@ def main():
         Xg, yg = synth_guard(250_000)
         gp = {"objective": "binary", "num_leaves": 63, "max_bin": 255,
               "learning_rate": 0.1, "verbosity": -1}
-        g_ips, g_auc, _, _ = run_config(Xg[:200_000], yg[:200_000],
+        g_ips, g_auc, _, _, _ = run_config(Xg[:200_000], yg[:200_000],
                                         Xg[200_000:], yg[200_000:], gp,
                                         10, 40, windows=1,
                                         cat_features=[10, 11],
@@ -452,6 +463,10 @@ def main():
     extras += f"; median-of-{args.windows}"
     extras += (f"; predict_rps="
                f"{_snap_gauge(snap, 'bench.predict_rps'):.0f}")
+    v = _snap_gauge(snap, "bench.shap_rows_per_sec")
+    if v is not None:
+        # device-SHAP explain throughput on the same holdout rows
+        extras += f"; shap_rps={v:.0f}"
     v = _snap_gauge(snap, "bench.hist_partition")
     extras += f"; partition={'on' if v else 'off'}"
     if not args.donate:

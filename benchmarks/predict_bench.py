@@ -5,7 +5,10 @@ Grid: batch sizes {1, 128, 10k, 1M} x forest sizes {50, 500} trees
 separately from STEADY-STATE per-call latency and rows/sec — the
 serving numbers docs/perf.md's "Serving" section records. ``--legacy``
 times the pre-PR path (per-tree scan traversal, no bucketing, no
-stacked-forest cache) for the speedup ratio.
+stacked-forest cache) for the speedup ratio. ``--shap-batches`` adds
+pred_contrib (SHAP) cells on the same grid — device engine path, plus
+the host rows-vectorized path and a per-cell speedup under
+``--compare`` (docs/perf.md "Device SHAP").
 
 Run:
   python benchmarks/predict_bench.py                 # full grid
@@ -71,10 +74,44 @@ def bench_batch(bst, X, batch, legacy, min_steady_s=1.0, max_calls=50):
             "steady_calls": len(lat)}
 
 
+def bench_shap(bst, X, batch, host, min_steady_s=1.0, max_calls=50):
+    """One SHAP (pred_contrib) cell: device engine path vs the host
+    rows-vectorized path (``--compare``). First call carries the path
+    table build + compile; steady state is the serving number."""
+    rng = np.random.default_rng(1)
+    Xb = X[rng.integers(0, len(X), size=batch)]
+    if host:
+        hm = bst._to_host_model()
+        call = lambda: hm.predict(Xb, pred_contrib=True)  # noqa: E731
+    else:
+        call = lambda: bst.predict(Xb, pred_contrib=True)  # noqa: E731
+    t0 = time.time()
+    call()
+    first_s = time.time() - t0
+    lat = []
+    t_all = 0.0
+    for _ in range(max_calls):
+        t0 = time.time()
+        call()
+        dt = time.time() - t0
+        lat.append(dt)
+        t_all += dt
+        if t_all > min_steady_s and len(lat) >= 3:
+            break
+    med = sorted(lat)[len(lat) // 2]
+    return {"first_call_s": round(first_s, 4),
+            "steady_latency_s": round(med, 5),
+            "steady_rows_per_sec": round(batch / med, 1),
+            "steady_calls": len(lat)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--trees", type=str, default="50,500")
     ap.add_argument("--batches", type=str, default="1,128,10000,1000000")
+    ap.add_argument("--shap-batches", type=str, default="128,10000",
+                    help="pred_contrib (SHAP) batch sizes; '' skips "
+                         "the SHAP cells")
     ap.add_argument("--rows-train", type=int, default=20000)
     ap.add_argument("--features", type=int, default=28)
     ap.add_argument("--num-leaves", type=int, default=31)
@@ -104,6 +141,7 @@ def main():
                               args.features))
 
     results = []
+    shap_results = []
     for trees in trees_list:
         t0 = time.time()
         bst = _train_booster(args.rows_train, args.features, trees,
@@ -125,6 +163,22 @@ def main():
                 print(json.dumps({"trees": trees, "batch": batch,
                                   "speedup_vs_legacy":
                                   round(ratio, 2)}), flush=True)
+        for batch in [int(b) for b in args.shap_batches.split(",") if b]:
+            cell = bench_shap(bst, X_pool, batch, host=False)
+            rec = {"trees": trees, "batch": batch, "path": "device-shap",
+                   **cell}
+            shap_results.append(rec)
+            print(json.dumps(rec), flush=True)
+            if args.compare:
+                hcell = bench_shap(bst, X_pool, batch, host=True)
+                print(json.dumps({"trees": trees, "batch": batch,
+                                  "path": "host-shap", **hcell}),
+                      flush=True)
+                ratio = (cell["steady_rows_per_sec"]
+                         / hcell["steady_rows_per_sec"])
+                print(json.dumps({"trees": trees, "batch": batch,
+                                  "shap_speedup_vs_host":
+                                  round(ratio, 2)}), flush=True)
         print(json.dumps({"trees": trees, "train_s": round(train_s, 1)}),
               flush=True)
     # the aggregate line reads from an obs snapshot (the snapshot is
@@ -132,6 +186,11 @@ def main():
     best = max(results, key=lambda r: r["steady_rows_per_sec"])
     obs.set_gauge("bench.predict_rows_per_sec_best",
                   best["steady_rows_per_sec"], force=True)
+    if shap_results:
+        sbest = max(shap_results,
+                    key=lambda r: r["steady_rows_per_sec"])
+        obs.set_gauge("bench.shap_rows_per_sec",
+                      sbest["steady_rows_per_sec"], force=True)
     snap = obs.snapshot()
     if args.metrics_json:
         obs.dump_jsonl(args.metrics_json, snap)

@@ -240,16 +240,42 @@ class Booster:
                 start_iteration=start_iteration,
                 num_iteration=num_iteration, pred_leaf=pred_leaf,
                 pred_contrib=pred_contrib, **es_kwargs)
+        # upstream convention: extra predict kwargs act as per-call
+        # parameter overrides — forward the serving knobs to the engine
+        serving_kwargs = {k: v for k, v in _kwargs.items()
+                          if k.startswith("tpu_predict_")}
+        if pred_contrib and not es_kwargs.get("pred_early_stop"):
+            # SHAP-capable configs take the engine path: cached device
+            # path tables, bucketed zero-compile dispatch, tree
+            # sharding. Demoted engines (capability table) explain
+            # through the host model with a warned stand-down.
+            from . import capabilities
+            from .serve.shard import engine_kind
+            eng = self.engine
+            if bool(getattr(self.config, "linear_tree", False)):
+                why = "linear_tree"
+            else:
+                why = engine_kind(eng)
+            verdict = capabilities.sharded_shap_verdict(
+                engine_kind(eng), self.config)
+            if verdict == capabilities.SUPPORTED:
+                return eng.predict_contrib(
+                    data, start_iteration=start_iteration,
+                    num_iteration=num_iteration or -1,
+                    host_model=self._to_host_model(),
+                    force_f64=es_kwargs.get("contrib_force_f64"),
+                    **serving_kwargs)
+            if not getattr(self, "_warned_shap_demote", False):
+                self._warned_shap_demote = True
+                log.warning(capabilities.SHARDED_SHAP_MESSAGES.get(
+                    why, capabilities.SHARDED_SHAP_MESSAGES[
+                        "streaming"]))
         if pred_contrib or es_kwargs.get("pred_early_stop"):
             return self._host_predict(
                 self._to_host_model(), data, raw_score=raw_score,
                 start_iteration=start_iteration,
                 num_iteration=num_iteration, pred_leaf=pred_leaf,
                 pred_contrib=pred_contrib, **es_kwargs)
-        # upstream convention: extra predict kwargs act as per-call
-        # parameter overrides — forward the serving knobs to the engine
-        serving_kwargs = {k: v for k, v in _kwargs.items()
-                          if k.startswith("tpu_predict_")}
         return self.engine.predict(
             data, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration or -1, pred_leaf=pred_leaf,

@@ -429,6 +429,10 @@ class GBDT:
         # leaf values (_stack_model_list)
         self._models_version = 0
         self._stack_cache: Optional[Tuple[Tuple[int, int], Dict]] = None
+        # device-resident SHAP path-table cache (predict_contrib):
+        # same (len, version) key + LRU shape as _stack_cache, entries
+        # keyed by (start_tree, n_trees, dtype) slice
+        self._shap_cache: Optional[Tuple[Tuple[int, int], Dict]] = None
         # tree-sharded predict (serve/shard.py enable_tree_sharding):
         # when set, stacked forests are placed with the [T] axis
         # NamedSharding-split over this mesh and predicts take the
@@ -2269,6 +2273,7 @@ class GBDT:
         keys on (engine predict, Booster._to_host_model)."""
         self._models_version = getattr(self, "_models_version", 0) + 1
         self._stack_cache = None
+        self._shap_cache = None
 
     def can_fuse_iters(self) -> bool:
         """True when boosting iterations are expressible as one scanned
@@ -2906,6 +2911,248 @@ class GBDT:
         raw = (raw_parts[0] if len(raw_parts) == 1
                else np.concatenate(raw_parts, axis=0))
         return raw, None
+
+    # ------------------------------------------------------------------
+    def predict_contrib(self, X, start_iteration: int = 0,
+                        num_iteration: int = -1, host_model=None,
+                        force_f64=None, **overrides) -> np.ndarray:
+        """Device-native TreeSHAP (``pred_contrib``) through the same
+        serving machinery as :meth:`predict`: memoized device-resident
+        path tables (``_shap_cache``), pow2 row buckets + fixed-size
+        chunking + the InflightWindow double buffer, and the
+        tree-sharded scan when a ``_predict_mesh`` is enabled.
+
+        Output is host-format: ``[n, n_feat + 1]`` for one class, else
+        ``[n, K * (n_feat + 1)]`` — identical to
+        ``HostModel.predict(pred_contrib=True)`` (f64-exact on CPU
+        backends; documented ~3e-5 f32 tolerance on TPU)."""
+        if not obs.any_enabled():
+            return self._predict_contrib_impl(
+                X, start_iteration, num_iteration, host_model,
+                force_f64, **overrides)
+        return obs.predict_instrumented(
+            lambda: self._predict_contrib_impl(
+                X, start_iteration, num_iteration, host_model,
+                force_f64, **overrides), X)
+
+    def _predict_contrib_impl(self, X, start_iteration: int,
+                              num_iteration: int, host_model,
+                              force_f64, **overrides) -> np.ndarray:
+        from ..ops import shap as shap_ops
+        if host_model is None:
+            # SHAP walks host trees (original-feature split ids, folded
+            # init-score bias) — same cached conversion predict's
+            # linear-tree path uses
+            from ..io.model_text import HostModel
+            hm_key = (len(self.models), self._models_version)
+            cache = getattr(self, "_hm_cache", (None, None))
+            if cache[0] != hm_key:
+                cache = (hm_key,
+                         HostModel.from_engine(self, self.config))
+                self._hm_cache = cache
+            host_model = cache[1]
+        ds = self.train_set
+        sparse_in = hasattr(X, "tocsr") and not isinstance(X, np.ndarray)
+        if sparse_in:
+            X = X.tocsr()
+            n_rows = X.shape[0]
+            n_cols = X.shape[1]
+        else:
+            from ..io.dataset import apply_pandas_categorical
+            X = apply_pandas_categorical(
+                X, getattr(ds, "pandas_categorical", None))
+            X = np.ascontiguousarray(
+                np.asarray(Dataset._to_matrix(X), np.float64))
+            n_rows, n_cols = X.shape
+        if n_cols != ds.num_total_features:
+            log.fatal(
+                f"The number of features in data ({n_cols}) is "
+                f"not the same as it was in training data "
+                f"({ds.num_total_features})")
+        n_feat = ds.num_total_features
+        K = max(self.num_class, 1)
+        total_iters = len(self.models) // K
+        if num_iteration <= 0:
+            num_iteration = total_iters - start_iteration
+        num_iteration = min(num_iteration, total_iters - start_iteration)
+        n_trees = num_iteration * K
+        start_tree = start_iteration * K
+        if n_trees <= 0:
+            out = np.zeros((n_rows, K, n_feat + 1), np.float64)
+        else:
+            trees = host_model.trees[start_tree:start_tree + n_trees]
+            if all(t.num_leaves <= 1 for t in trees):
+                out = shap_ops.stump_only_contrib(trees, n_rows,
+                                                  n_feat, K)
+            else:
+                with obs.span("predict/contrib", rows=n_rows,
+                              trees=n_trees):
+                    out = self._run_shap_chunks(
+                        trees, X, sparse_in, n_rows, n_feat, K,
+                        start_tree, n_trees, force_f64, overrides)
+            if self.average_output:
+                out = out / max(n_trees // K, 1)
+        return out[:, 0, :] if K == 1 else out.reshape(
+            n_rows, K * (n_feat + 1))
+
+    def _shap_tables_for(self, trees, start_tree: int, n_trees: int,
+                         n_feat: int, K: int, dtype_name: str, mesh):
+        """Device-resident stacked path tables for a tree slice,
+        memoized next to ``_stack_model_list``'s forest cache: keyed on
+        ``(len(models), _models_version)`` so hot-swaps re-cost, LRU
+        over ``(start_tree, n_trees, dtype)`` slices, shape-stabilized
+        (config leaf cap + pow2 depth/slot/tree-count buckets) exactly
+        like ``_stack_for_predict`` so warm SHAP re-derives nothing and
+        recompiles nothing within a bucket."""
+        from ..ops import shap as shap_ops
+        ver = (len(self.models), self._models_version)
+        key = (start_tree, n_trees, dtype_name)
+        cache = self._shap_cache
+        if cache is not None and cache[0] == ver and key in cache[1]:
+            entry = cache[1].pop(key)
+            cache[1][key] = entry          # LRU refresh
+            if obs.enabled():
+                obs.inc("predict.contrib_cache_hits")
+            return entry
+        if obs.enabled():
+            obs.inc("predict.contrib_cache_misses")
+        (L_a, D_a, U_a, NN_a), paths = shap_ops.shap_path_dims(trees)
+        partial = not (start_tree == 0 and n_trees == len(self.models))
+        if getattr(self, "_stable_predict_shapes", False) or partial:
+            # bucketed caps: leaf/node dims pinned to the config cap,
+            # depth/slot dims to pow2 buckets — successive hot-swapped
+            # models (or early-stop slices) in the same buckets reuse
+            # the compiled scan
+            L = max(L_a, int(self.config.num_leaves))
+            NN = max(NN_a, L - 1)
+            D = _next_pow2(max(D_a, 1))
+            U = _next_pow2(max(U_a, 1))
+            T_pad = _next_pow2(n_trees)
+        else:
+            L, D, U, NN = L_a, D_a, U_a, NN_a
+            T_pad = n_trees
+        if mesh is not None:
+            T_pad = _ceil_to(T_pad, int(mesh.devices.size))
+        stacked_np, dims = shap_ops.build_shap_tables(
+            trees, n_feat, K, dims=(L, D, U, NN),
+            pad_trees=T_pad - n_trees, paths=paths)
+        if mesh is not None:
+            from ..serve.shard import place_shap_sharded
+            dev = place_shap_sharded(stacked_np, mesh)
+        else:
+            dev = {k: jnp.asarray(v) for k, v in stacked_np.items()}
+        entry = (dev, dims, T_pad)
+        if cache is None or cache[0] != ver:
+            cache = (ver, {})
+            self._shap_cache = cache
+        cache[1][key] = entry
+        while len(cache[1]) > _STACK_CACHE_ENTRIES:
+            cache[1].pop(next(iter(cache[1])))
+        return entry
+
+    def _run_shap_chunks(self, trees, X, sparse_in: bool, n_rows: int,
+                         n_feat: int, K: int, start_tree: int,
+                         n_trees: int, force_f64, overrides):
+        """Run the SHAP scan over ``X`` with the SAME batch-shape
+        bucketing, fixed-size chunking, and double-buffered D2H
+        streaming as ``_run_forest_chunks`` — the per-chunk host work
+        is only the routing-bit pass (vectorized numpy), the tables
+        come from the device cache. Returns ``[n, K, n_feat+1]`` f64."""
+        import contextlib
+        from ..config import coerce_bool
+        from ..ops import shap as shap_ops
+        from ..ops.predict import onehot_bounded_rows
+        cfg = self.config
+
+        def knob(name, cast):
+            if overrides and name in overrides:
+                return cast(overrides[name])
+            return cast(getattr(cfg, name))
+
+        if force_f64 is None:
+            force_f64 = jax.default_backend() == "cpu"
+        mesh = getattr(self, "_predict_mesh", None)
+        if force_f64 and jax.default_backend() != "cpu":
+            # exact-f64 escape hatch runs on the host CPU device —
+            # never through an accelerator mesh
+            mesh = None
+        dtype_name = "float64" if force_f64 else "float32"
+        ctx = contextlib.ExitStack()
+        if force_f64:
+            x64_ctx = getattr(jax, "enable_x64", None)
+            if x64_ctx is None:
+                from jax.experimental import enable_x64 as x64_ctx
+            ctx.enter_context(x64_ctx())
+            if jax.default_backend() != "cpu":
+                ctx.enter_context(
+                    jax.default_device(jax.devices("cpu")[0]))
+        out = np.zeros((n_rows, K, n_feat + 1), np.float64)
+        with ctx:
+            dev, (L, D, U, NN), T_pad = self._shap_tables_for(
+                trees, start_tree, n_trees, n_feat, K, dtype_name,
+                mesh)
+            chunk = max(knob("tpu_predict_chunk_rows", int), 1024)
+            # bound the scan's widest [rows, L*max(D, U+2)] operand the
+            # same way the level traversal bounds its one-hots
+            chunk = min(chunk, onehot_bounded_rows(L * max(D, U + 2)))
+            if n_rows <= chunk:
+                pad_to = predict_pad_rows(
+                    n_rows, chunk,
+                    knob("tpu_predict_buckets", coerce_bool))
+                plan = [(0, n_rows, pad_to)]
+            else:
+                plan = [(s, min(chunk, n_rows - s), chunk)
+                        for s in range(0, n_rows, chunk)]
+            if obs.enabled():
+                obs.inc("predict.chunks", len(plan))
+                obs.inc("predict.padded_rows",
+                        sum(p - r for _s, r, p in plan))
+            use_sharded = (mesh is not None
+                           and int(mesh.devices.size) > 1
+                           and T_pad % int(mesh.devices.size) == 0)
+            run = (shap_ops.sharded_scan_kernel(
+                       mesh, D, U, NN, n_feat, K, dtype_name)
+                   if use_sharded else
+                   shap_ops._scan_kernel(D, U, NN, n_feat, K,
+                                         dtype_name))
+
+            def drain(item):
+                phi_dev, lo, rows = item
+                out[lo:lo + rows] = np.asarray(phi_dev,
+                                               np.float64)[:rows]
+
+            window = InflightWindow(1, drain)
+            for start, rows, pad_to in plan:
+                if sparse_in:
+                    blk = np.asarray(
+                        X[start:start + rows].toarray(), np.float64)
+                else:
+                    blk = X[start:start + rows]
+                if pad_to > rows:
+                    blk = np.concatenate(
+                        [blk, np.zeros((pad_to - rows, blk.shape[1]),
+                                       np.float64)])
+                # host routing-bit pass: once per (rows-bucket, model
+                # version) chunk, not per call — tables are cached
+                conds = np.stack(
+                    [shap_ops._host_cond_bits(t, blk, NN)
+                     for t in trees])
+                if T_pad > len(trees):
+                    conds = np.concatenate(
+                        [conds,
+                         np.zeros((T_pad - len(trees),)
+                                  + conds.shape[1:], np.uint8)])
+                batch = dict(dev)
+                if use_sharded:
+                    from ..serve.shard import place_tree_axis
+                    batch["cond"] = place_tree_axis(mesh, conds)
+                else:
+                    batch["cond"] = jnp.asarray(conds)
+                phi_dev = run(batch)
+                phi_dev.copy_to_host_async()
+                window.push((phi_dev, start, rows))
+            window.drain()
+        return out
 
     @property
     def current_iteration(self) -> int:

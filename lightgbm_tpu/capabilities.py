@@ -51,6 +51,7 @@ __all__ = [
     "HIST_PARTITION_MIN_ROWS", "hist_partition_auto",
     "DEVICE_INGEST", "device_ingest_verdict", "forced_engine",
     "SHARDED_PREDICT", "sharded_predict_verdict",
+    "SHARDED_SHAP", "SHARDED_SHAP_MESSAGES", "sharded_shap_verdict",
     "STREAM_RECUT", "stream_recut_verdict",
     "stream_recut_verdict_params",
 ]
@@ -377,6 +378,52 @@ def sharded_predict_verdict(engine: str, config=None) -> str:
                                            False)):
         return DEMOTE
     return SHARDED_PREDICT.get(engine, DEMOTE)
+
+
+# which engines' pred_contrib (TreeSHAP) can take the ENGINE path —
+# device-resident cached path tables, bucketed zero-compile dispatch,
+# and (mesh permitting) the tree-sharded scan (gbdt.predict_contrib /
+# ops/shap.py sharded_scan_kernel). DART's in-place leaf rescales churn
+# the cached tables' version every iteration; RF's per-tree averaging
+# is host-verified only against forest_shap_batch; the streaming
+# engine has no stacked device surface. Demotion means: explain through
+# the cached host model (identical values), never refuse the call.
+SHARDED_SHAP: Dict[str, str] = {
+    "gbdt": SUPPORTED,
+    "dart": DEMOTE,
+    "rf": DEMOTE,
+    "streaming": DEMOTE,
+}
+
+# exact warned-stand-down wording (basic.py logs the matching line
+# once per booster when a pred_contrib call demotes to the host path)
+SHARDED_SHAP_MESSAGES: Dict[str, str] = {
+    "dart": ("device SHAP demoted for the DART engine (capabilities."
+             "SHARDED_SHAP): in-place leaf rescales churn the cached "
+             "path tables every iteration; explaining through the "
+             "host model"),
+    "rf": ("device SHAP demoted for the random-forest engine "
+           "(capabilities.SHARDED_SHAP); explaining through the host "
+           "model"),
+    "streaming": ("device SHAP demoted for the streaming engine "
+                  "(capabilities.SHARDED_SHAP): it predicts through "
+                  "the host model and has no stacked device surface"),
+    "linear_tree": ("device SHAP demoted for linear_tree models "
+                    "(capabilities.SHARDED_SHAP): linear-leaf "
+                    "contributions ride the host-model path"),
+}
+
+
+def sharded_shap_verdict(engine: str, config=None) -> str:
+    """Verdict for routing one engine's ``pred_contrib`` through the
+    device-native SHAP path. ``linear_tree`` configs demote on EVERY
+    engine, mirroring :func:`sharded_predict_verdict` (the host SHAP
+    path refuses linear trees loudly; the engine path never sees
+    them)."""
+    if config is not None and bool(getattr(config, "linear_tree",
+                                           False)):
+        return DEMOTE
+    return SHARDED_SHAP.get(engine, DEMOTE)
 
 
 # can streamed per-(rank, block) score slots be RE-CUT onto a changed

@@ -188,7 +188,8 @@ class HostModel:
             return out
         if pred_contrib:
             return self._predict_contrib(X, use, K,
-                                         force_f64=contrib_force_f64)
+                                         force_f64=contrib_force_f64,
+                                         slice_key=(t0, t1))
         raw = np.zeros((n, K), dtype=np.float64)
         obj0 = self.objective_str.split(" ")[0]
         early = (pred_early_stop and not self.average_output
@@ -244,8 +245,9 @@ class HostModel:
             return np.sign(r) * r * r
         return raw[:, 0] if raw.shape[1] == 1 else raw
 
-    def _predict_contrib(self, X, trees, K, force_f64=None):
-        from ..ops.shap import forest_shap_batch
+    def _predict_contrib(self, X, trees, K, force_f64=None,
+                          slice_key=None):
+        from ..ops.shap import build_shap_tables, forest_shap_batch
         if any(getattr(t, "is_linear", False) for t in trees):
             # the reference likewise refuses SHAP for linear trees —
             # constant-leaf attributions would not sum to the prediction
@@ -253,8 +255,25 @@ class HostModel:
                       "models")
         n = X.shape[0]
         n_feat = self.max_feature_idx + 1
+        tables = None
+        if slice_key is not None:
+            # per-slice path-table cache: a HostModel is immutable once
+            # built (Booster._to_host_model already caches the model
+            # itself), so the demoted/host SHAP route stops paying the
+            # per-call path walk too. Stump-only slices build None —
+            # don't cache those, forest_shap_batch short-circuits them.
+            cache = getattr(self, "_shap_table_cache", None)
+            if cache is None:
+                cache = self._shap_table_cache = {}
+            tables = cache.get(slice_key)
+            if tables is None:
+                tables = build_shap_tables(trees, n_feat, K)
+                if tables is not None:
+                    cache[slice_key] = tables
+                    while len(cache) > 8:
+                        cache.pop(next(iter(cache)))
         out = forest_shap_batch(trees, X, n_feat, K=K,
-                                force_f64=force_f64)
+                                force_f64=force_f64, tables=tables)
         if self.average_output and len(trees):
             # RF: contributions average like the prediction does, keeping
             # the SHAP local-accuracy invariant sum(contrib) == raw pred

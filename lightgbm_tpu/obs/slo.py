@@ -254,7 +254,8 @@ class SlidingCounter(_TimeRing):
 WATCHED_HISTOGRAMS = ("predict/call", "train/round",
                       "serve/queue_wait", "serve/e2e", "serve/batch",
                       "serve/coalesce", "serve/registry_checkout",
-                      "serve/dispatch", "serve/postprocess")
+                      "serve/dispatch", "serve/postprocess",
+                      "serve/explain")
 WATCHED_COUNTERS = ("predict.requests", "predict.errors",
                     "predict.stack_cache_hits",
                     "predict.stack_cache_misses")
@@ -332,6 +333,11 @@ class SloTracker:
         qw50, qw99 = self.hists["serve/queue_wait"].quantiles(
             (0.50, 0.99), now=now)
         d99 = self.hists["serve/dispatch"].quantile(0.99, now=now)
+        # explain (pred_contrib) riders' end-to-end latency: their own
+        # window, so a mixed predict+explain workload's p99 target can
+        # be held per kind (serve/service.py feeds serve/explain
+        # alongside serve/e2e for contrib batches only)
+        x99 = self.hists["serve/explain"].quantile(0.99, now=now)
 
         def ms(v):
             return None if v is None else v * 1000.0
@@ -357,6 +363,7 @@ class SloTracker:
             "slo.queue_wait_p50_ms": ms(qw50),
             "slo.queue_wait_p99_ms": ms(qw99),
             "slo.dispatch_p99_ms": ms(d99),
+            "slo.explain_p99_ms": ms(x99),
             "slo.device_share": (min(disp_sum / batch_sum, 1.0)
                                  if batch_sum > 0 else None),
             "slo.error_ratio": (errors / requests if requests else None),

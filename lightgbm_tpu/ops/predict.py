@@ -391,6 +391,14 @@ def _forest_predict_impl(stacked, bins, feat_num_bin, feat_has_nan,
     return _class_accumulate(vals, class_index, num_class), leaves
 
 
+def onehot_bounded_rows(width: int, floor: int = 1024) -> int:
+    """Largest row count whose ``[rows, width]`` one-hot style operand
+    stays within LEVEL_ONEHOT_BUDGET — the same peak-operand bound the
+    level traversal applies per tree block, reused by the SHAP scan's
+    chunk planner to cap its ``[rows, L*D]`` path-pick operand."""
+    return max(int(LEVEL_ONEHOT_BUDGET // max(int(width), 1)), int(floor))
+
+
 def predict_program_cache_size() -> int:
     """Number of distinct compiled forest-predict programs this process
     holds — the quantity the batch-shape bucketing bounds (tests pin it

@@ -309,10 +309,98 @@ def _host_cond_bits(tree, X, NN):
 import functools as _functools
 
 
-@_functools.lru_cache(maxsize=32)
-def _scan_kernel(D, U, NN, n_feat, K, dtype):
-    """Build the jitted per-chunk forest scan (shapes static; cached so
-    repeated pred_contrib calls reuse the compiled executable)."""
+def _dims_from(trees, all_paths):
+    L = max((t.num_leaves for t in trees), default=1)
+    D = max((int(t.leaf_depths().max()) if t.num_leaves > 1 else 0
+             for t in trees), default=0)
+    NN = max((t.num_nodes for t in trees), default=0)
+    U = max((len({f for _, _, f, _ in es})
+             for paths in all_paths for _, es in paths), default=0)
+    return L, D, U, NN
+
+
+def shap_path_dims(trees):
+    """Actual table dimensions ``(L, D, U, NN)`` for a tree list, plus
+    the DFS paths (the U computation needs the full root->leaf walk, so
+    callers reuse it for :func:`build_shap_tables` instead of walking
+    twice)."""
+    all_paths = [_walk_paths(t) if t.num_leaves > 1 else []
+                 for t in trees]
+    return _dims_from(trees, all_paths), all_paths
+
+
+def _inert_tables(L, D, U, n_feat, K):
+    """Pad-tree tables: e_act=0 makes every entry match, z=1/s_act=0
+    makes every slot the (zero=1, one=1) dummy, so contrib = total *
+    (o - z) == 0 exactly, and cls=0 zeroes the class scatter on top —
+    a pad tree contributes nothing in any dtype."""
+    return dict(node_id=np.zeros((L, D), np.int32),
+                dirs=np.zeros((L, D), np.float32),
+                e_act=np.zeros((L, D), np.float32),
+                M=np.zeros((L, D, U), np.float32),
+                z=np.ones((L, U), np.float64),
+                s_act=np.zeros((L, U), np.float32),
+                s_feat=np.full((L, U), n_feat, np.int32),
+                vleaf=np.zeros(L, np.float64),
+                expected=np.float64(0.0),
+                cls=np.zeros(K, np.float32))
+
+
+def build_shap_tables(trees, n_feat, K, dims=None, pad_trees=0,
+                      paths=None):
+    """Host prep for the whole forest, hoisted out of
+    :func:`forest_shap_batch` so callers (the engine's device-resident
+    SHAP cache, ``HostModel``'s per-slice cache) can build once and
+    reuse across calls.
+
+    Returns ``(stacked, (L, D, U, NN))`` where ``stacked`` maps table
+    name -> ``[T + pad_trees, ...]`` numpy array, or ``None`` when
+    there is nothing to scan (empty / all-stump forest — callers take
+    the bias-only path). ``dims`` caps are lower bounds: actual tree
+    dims are maxed in, so bucketed callers get stable shapes without
+    ever truncating a tree. ``pad_trees`` appends inert pad trees
+    (see :func:`_inert_tables`) so the stacked tree axis can be padded
+    to a pow2 / mesh-divisible length."""
+    if not trees or all(t.num_leaves <= 1 for t in trees):
+        return None
+    if paths is None:
+        actual, paths = shap_path_dims(trees)
+    else:
+        actual = _dims_from(trees, paths)
+    if dims is None:
+        L, D, U, NN = actual
+    else:
+        L, D, U, NN = (max(a, b) for a, b in zip(actual, dims))
+    tables = []
+    for ti, (t, tree_paths) in enumerate(zip(trees, paths)):
+        tab = _path_tables(t, L, D, U, n_feat, paths=tree_paths)
+        cls = np.zeros(K, np.float32)
+        cls[ti % K] = 1.0
+        tab["cls"] = cls
+        tables.append(tab)
+    if pad_trees:
+        tables.extend([_inert_tables(L, D, U, n_feat, K)] *
+                      int(pad_trees))
+    stacked = {k: np.stack([tab[k] for tab in tables])
+               for k in tables[0]}
+    return stacked, (L, D, U, NN)
+
+
+def stump_only_contrib(trees, n, n_feat, K):
+    """Bias-only output for forests with no splits anywhere — nothing
+    to scan, every row gets each stump's constant in the bias column."""
+    out = np.zeros((n, K, n_feat + 1), np.float64)
+    for i, t in enumerate(trees):
+        out[:, i % K, -1] += (float(t.leaf_value[0])
+                              if len(t.leaf_value) else 0.0)
+    return out
+
+
+def _scan_body(D, U, NN, n_feat, K, dtype):
+    """The per-chunk forest scan, unjitted — shared by the
+    single-device kernel (:func:`_scan_kernel`) and the tree-sharded
+    wrapper (:func:`sharded_scan_kernel`), which runs it per shard and
+    psums the per-tree phi sums (order-free per feature)."""
     import jax
     import jax.numpy as jnp
 
@@ -385,7 +473,6 @@ def _scan_kernel(D, U, NN, n_feat, K, dtype):
             * phi_t[:, None, :]
         return phi, 0.0
 
-    @jax.jit
     def run(stacked):
         n = stacked["cond"].shape[1]
         phi0 = jnp.zeros((n, K, n_feat + 1), dtype)
@@ -395,43 +482,74 @@ def _scan_kernel(D, U, NN, n_feat, K, dtype):
     return run
 
 
+@_functools.lru_cache(maxsize=32)
+def _scan_kernel(D, U, NN, n_feat, K, dtype):
+    """Jitted single-device forest scan (shapes static; cached so
+    repeated pred_contrib calls reuse the compiled executable)."""
+    import jax
+    return jax.jit(_scan_body(D, U, NN, n_feat, K, dtype))
+
+
+# (mesh, shape signature) -> jitted sharded scan; same lifetime pattern
+# as ops/predict.py's _SHARDED_TRAVERSE (meshes are few and long-lived)
+_SHARDED_SCAN: dict = {}
+
+
+def sharded_scan_kernel(mesh, D, U, NN, n_feat, K, dtype):
+    """Tree-sharded forest scan over ``mesh``'s tree axis.
+
+    Each device scans only its shard of the stacked ``[T, ...]`` path
+    tables (and the routing bits, sharded the same way), then one
+    ``psum`` over the tree axis combines the per-shard phi sums —
+    per-tree contributions are order-free per feature, so the reduce is
+    exact in f64 and only reassociates an already-documented-tolerance
+    sum in f32. Output is replicated (like ``forest_predict_sharded``).
+    """
+    key = (mesh, D, U, NN, n_feat, K, dtype)
+    fn = _SHARDED_SCAN.get(key)
+    if fn is None:
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+        from ..serve.shard import TREE_AXIS
+
+        body = _scan_body(D, U, NN, n_feat, K, dtype)
+
+        def local(stacked):
+            return jax.lax.psum(body(stacked), TREE_AXIS)
+
+        def run(stacked):
+            specs = {k: PartitionSpec(TREE_AXIS) for k in stacked}
+            return shard_map(local, mesh=mesh, in_specs=(specs,),
+                             out_specs=PartitionSpec())(stacked)
+
+        fn = jax.jit(run)
+        _SHARDED_SCAN[key] = fn
+    return fn
+
+
 def forest_shap_batch(trees, X, n_feat, K=1, row_chunk=131072,
-                      force_f64=None):
+                      force_f64=None, tables=None):
     """Vectorized TreeSHAP over a whole forest: ``[n, K, n_feat+1]``.
 
     ``force_f64``: run the scan in float64. Defaults to True on CPU
     backends; on a TPU host setting it True routes the scan to the
     host CPU device (slower, exact) — the escape hatch for exact-f64
     parity with stock LightGBM's double-precision TreeSHAP.
+
+    ``tables``: a prebuilt :func:`build_shap_tables` result for these
+    exact trees — callers that hold a table cache (``HostModel``)
+    skip the per-call path walk entirely.
     """
     import jax
 
     X = np.ascontiguousarray(np.asarray(X, np.float64))
     n = X.shape[0]
-    if not trees or all(t.num_leaves <= 1 for t in trees):
-        out = np.zeros((n, K, n_feat + 1), np.float64)
-        for i, t in enumerate(trees):
-            out[:, i % K, -1] += (float(t.leaf_value[0])
-                                  if len(t.leaf_value) else 0.0)
-        return out
-    L = max(t.num_leaves for t in trees)
-    depths = [int(t.leaf_depths().max()) if t.num_leaves > 1 else 0
-              for t in trees]
-    D = max(depths)
-    NN = max(t.num_nodes for t in trees)
-    all_paths = [_walk_paths(t) if t.num_leaves > 1 else []
-                 for t in trees]
-    U = max((len({f for _, _, f, _ in es})
-             for paths in all_paths for _, es in paths), default=0)
-    tables = []
-    for ti, (t, paths) in enumerate(zip(trees, all_paths)):
-        tab = _path_tables(t, L, D, U, n_feat, paths=paths)
-        cls = np.zeros(K, np.float32)
-        cls[ti % K] = 1.0
-        tab["cls"] = cls
-        tables.append(tab)
-    stacked = {k: np.stack([tab[k] for tab in tables])
-               for k in tables[0]}
+    if tables is None:
+        tables = build_shap_tables(trees, n_feat, K)
+    if tables is None:
+        return stump_only_contrib(trees, n, n_feat, K)
+    stacked, (L, D, U, NN) = tables
 
     if force_f64 is None:
         force_f64 = jax.default_backend() == "cpu"

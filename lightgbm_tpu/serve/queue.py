@@ -60,13 +60,22 @@ _req_ids = itertools.count(1)
 
 
 class PredictRequest:
-    """One queued predict: rows + the future its caller blocks on."""
+    """One queued request: rows + the future its caller blocks on.
+
+    ``kind`` is the predict kind the rider asked for ("predict" |
+    "contrib"): requests coalesce only within one (model, kind) lane —
+    an explain rider never joins a predict batch (their dispatches run
+    different programs with different output shapes and latency
+    envelopes), but both lanes share the flush-cause taxonomy, the
+    global cross-model FIFO, and the SLO plane."""
 
     __slots__ = ("model_id", "X", "rows", "future", "t_enqueue",
-                 "deadline", "dispatched", "id", "flush_cause")
+                 "deadline", "dispatched", "id", "flush_cause", "kind")
 
-    def __init__(self, model_id: str, X, budget_s: float):
+    def __init__(self, model_id: str, X, budget_s: float,
+                 kind: str = "predict"):
         self.model_id = str(model_id)
+        self.kind = str(kind)
         self.X = X
         self.rows = int(np.shape(X)[0])
         self.future: Future = Future()
@@ -98,19 +107,24 @@ class MicroBatchQueue:
         # global submit order (lazily cleaned of dispatched entries —
         # pops remove from the per-model deques only)
         self._order: Deque[PredictRequest] = deque()
-        self._by_model: Dict[str, Deque[PredictRequest]] = {}
-        self._prefix: Dict[str, int] = {}
-        self._open: Dict[str, bool] = {}
+        # coalescing lanes keyed (model_id, kind): explain riders never
+        # coalesce into a predict batch
+        self._by_model: Dict[Tuple[str, str],
+                             Deque[PredictRequest]] = {}
+        self._prefix: Dict[Tuple[str, str], int] = {}
+        self._open: Dict[Tuple[str, str], bool] = {}
         self._depth = 0
         self._cond = threading.Condition()
         self._closed = False
 
     # ------------------------------------------------------------------
-    def submit(self, model_id: str, X) -> Future:
+    def submit(self, model_id: str, X,
+               kind: str = "predict") -> Future:
         """Enqueue one request; returns the Future its rows resolve
         through. Raises RuntimeError after close() — a shutting-down
-        service must refuse loudly, not drop silently."""
-        req = PredictRequest(model_id, X, self.budget_s)
+        service must refuse loudly, not drop silently. ``kind`` picks
+        the coalescing lane (strict FIFO within one (model, kind))."""
+        req = PredictRequest(model_id, X, self.budget_s, kind=kind)
         with self._cond:
             if self._closed:
                 raise RuntimeError("serve queue is closed")
@@ -121,21 +135,23 @@ class MicroBatchQueue:
                 # the flow (submit -> carrying-batch arrows per rider)
                 _tracing.record_flow("serve/req", req.id, "s",
                                      {"model": req.model_id,
+                                      "kind": req.kind,
                                       "rows": req.rows})
-            d = self._by_model.get(req.model_id)
+            lane = (req.model_id, req.kind)
+            d = self._by_model.get(lane)
             if d is None:
-                d = self._by_model[req.model_id] = deque()
+                d = self._by_model[lane] = deque()
             if not d:
                 # a lone head is always its own prefix, oversize or not
-                self._prefix[req.model_id] = req.rows
-                self._open[req.model_id] = True
-            elif self._open[req.model_id]:
-                fits = (self._prefix[req.model_id] + req.rows
+                self._prefix[lane] = req.rows
+                self._open[lane] = True
+            elif self._open[lane]:
+                fits = (self._prefix[lane] + req.rows
                         <= self.max_batch_rows)
                 if fits:
-                    self._prefix[req.model_id] += req.rows
+                    self._prefix[lane] += req.rows
                 else:
-                    self._open[req.model_id] = False
+                    self._open[lane] = False
             d.append(req)
             self._order.append(req)
             self._depth += 1
@@ -174,9 +190,9 @@ class MicroBatchQueue:
             q.popleft()
         return q[0] if q else None
 
-    def _rescan_prefix(self, model_id: str,
+    def _rescan_prefix(self, lane: Tuple[str, str],
                        d: "Deque[PredictRequest]") -> None:
-        """Rebuild ``_prefix``/``_open`` for a model's remaining deque
+        """Rebuild ``_prefix``/``_open`` for a lane's remaining deque
         after a pop — O(next batch), it stops at the cap. Caller holds
         the lock."""
         acc = 0
@@ -187,8 +203,8 @@ class MicroBatchQueue:
                 opened = False
                 break
             acc += r.rows
-        self._prefix[model_id] = acc
-        self._open[model_id] = opened
+        self._prefix[lane] = acc
+        self._open[lane] = opened
 
     def next_batch(self, poll_s: float = 0.05
                    ) -> Optional[Tuple[str, List[PredictRequest]]]:
@@ -207,6 +223,7 @@ class MicroBatchQueue:
                 if head is None:
                     return None
             model_id = head.model_id
+            lane = (head.model_id, head.kind)
             # coalescing window: sleep toward the oldest deadline,
             # waking on every submit to re-check the fill level. The
             # exit branch IS the flush cause — stamped on the popped
@@ -216,10 +233,10 @@ class MicroBatchQueue:
             # oldest request's budget ran out, "close" = shutdown).
             cause = "close"
             while not self._closed:
-                if self._prefix.get(model_id, 0) >= self.max_batch_rows:
+                if self._prefix.get(lane, 0) >= self.max_batch_rows:
                     cause = "fill"
                     break
-                if not self._open.get(model_id, True):
+                if not self._open.get(lane, True):
                     # a non-fitting request FROZE the prefix — under
                     # strict FIFO nothing can ever join this batch, so
                     # waiting out the budget would be pure added
@@ -231,7 +248,7 @@ class MicroBatchQueue:
                     cause = "deadline"
                     break
                 self._cond.wait(head.deadline - now)
-            d = self._by_model.get(model_id)
+            d = self._by_model.get(lane)
             if not d:
                 return None         # close() drained it mid-wait
             batch: List[PredictRequest] = []
@@ -249,9 +266,9 @@ class MicroBatchQueue:
                     break
             self._depth -= len(batch)
             if d:
-                self._rescan_prefix(model_id, d)
+                self._rescan_prefix(lane, d)
             else:
-                del self._by_model[model_id]
-                self._prefix.pop(model_id, None)
-                self._open.pop(model_id, None)
+                del self._by_model[lane]
+                self._prefix.pop(lane, None)
+                self._open.pop(lane, None)
             return (model_id, batch)

@@ -265,8 +265,11 @@ class ModelRegistry:
         if eng is not None:
             # the stacked-forest device cache IS the HBM residency;
             # dropping it releases the device buffers once in-flight
-            # dispatches finish (tests pin the live-buffer count)
+            # dispatches finish (tests pin the live-buffer count).
+            # The SHAP path-table cache rides the same residency: an
+            # evicted tenant must not pin its explain tables either
             eng._stack_cache = None
+            eng._shap_cache = None
         entry.resident = False
         entry.bytes = 0
         entry.key = None

@@ -181,18 +181,29 @@ class PredictService:
         discriminator."""
         return self._inflight
 
-    def submit(self, model_id: str, X) -> Future:
+    def submit(self, model_id: str, X,
+               kind: str = "predict") -> Future:
         """Enqueue one request; the Future resolves to exactly the rows
-        submitted (converted model output), or raises what the predict
-        raised."""
-        return self.queue.submit(model_id, X)
+        submitted, or raises what the predict raised.
+
+        ``kind="predict"`` resolves to converted model output;
+        ``kind="contrib"`` resolves to per-feature SHAP contributions
+        (``pred_contrib`` layout: ``[rows, n_feat + 1]`` per class).
+        Explain riders ride the same micro-batch queue and flush rules
+        but coalesce only with other explain requests for the same
+        model — never into a predict batch."""
+        if kind not in ("predict", "contrib"):
+            raise ValueError(f"serve: unknown predict kind {kind!r} "
+                             f"(expected 'predict' or 'contrib')")
+        return self.queue.submit(model_id, X, kind=kind)
 
     def predict(self, model_id: str, X,
                 timeout: Optional[float] = None) -> np.ndarray:
         """Synchronous convenience wrapper over :meth:`submit`."""
         return self.submit(model_id, X).result(timeout=timeout)
 
-    def warmup(self, model_id: str, X) -> None:
+    def warmup(self, model_id: str, X,
+               kinds=("predict",)) -> None:
         """Compile the steady state for one model: predict one batch at
         every pow2 row bucket up to the batch cap (tiling ``X``'s first
         row), through the registry like real traffic. After this
@@ -202,7 +213,11 @@ class PredictService:
         dispatches alone and pads to a bigger pow2 bucket the warmup
         never visited — it pays a one-time compile per new bucket
         (bounded: log2(chunk/cap) programs); size the batch cap to
-        your largest expected request to avoid that."""
+        your largest expected request to avoid that.
+
+        ``kinds``: which predict kinds to warm — serve mixed
+        predict+explain traffic with ``kinds=("predict", "contrib")``
+        so warm SHAP dispatches also compile nothing."""
         X = np.asarray(X, dtype=np.float64)
         row = X[:1]
         if (self._thread is None or not self._thread.is_alive()
@@ -218,20 +233,22 @@ class PredictService:
         # reuse a compiled program (CompileWatch pins zero warm
         # compiles across swap + eviction in serve_bench)
         from ..boosting.gbdt import PREDICT_ROW_BUCKET_FLOOR
-        bucket = PREDICT_ROW_BUCKET_FLOOR
         cap = self.queue.max_batch_rows
-        while True:
-            # through the real dispatch path, one awaited bucket at a
-            # time (awaiting keeps warmup batches from coalescing WITH
-            # EACH OTHER into a skipped bucket): registry checkout and
-            # the engine's stack-cache mutation stay on the dispatch
-            # thread, so a warmup — or a tenant added mid-traffic —
-            # never races a live dispatch on the same engine
-            self.submit(model_id, np.repeat(row, bucket, axis=0)) \
-                .result()
-            if bucket >= cap:
-                break
-            bucket = min(bucket * 2, cap)
+        for kind in kinds:
+            bucket = PREDICT_ROW_BUCKET_FLOOR
+            while True:
+                # through the real dispatch path, one awaited bucket at
+                # a time (awaiting keeps warmup batches from coalescing
+                # WITH EACH OTHER into a skipped bucket): registry
+                # checkout and the engine's stack/SHAP-cache mutations
+                # stay on the dispatch thread, so a warmup — or a
+                # tenant added mid-traffic — never races a live
+                # dispatch on the same engine
+                self.submit(model_id, np.repeat(row, bucket, axis=0),
+                            kind=kind).result()
+                if bucket >= cap:
+                    break
+                bucket = min(bucket * 2, cap)
         obs.heartbeat("serve")
 
     # ------------------------------------------------------------------
@@ -258,10 +275,13 @@ class PredictService:
                   admitted: bool = False) -> None:
         rows = sum(r.rows for r in batch)
         # the queue stamped WHY it flushed onto the popped requests;
-        # warmup-era direct calls (tests) may carry none
+        # warmup-era direct calls (tests) may carry none. The batch is
+        # kind-homogeneous by the queue's (model, kind) lanes.
         cause = batch[0].flush_cause or "fill"
+        kind = getattr(batch[0], "kind", "predict")
         with obs.span("serve/batch", model=model_id, riders=len(batch),
-                      rows=rows, cause=cause, req=batch[0].id) as bsp:
+                      rows=rows, cause=cause, kind=kind,
+                      req=batch[0].id) as bsp:
             if not admitted and obs.any_enabled():
                 self._admission_records(batch)
             X = self._coalesce(batch, rows, cause)
@@ -345,10 +365,12 @@ class PredictService:
             for req in batch:
                 _resolve(req, exc=e)
             return
+        kind = getattr(batch[0], "kind", "predict")
         try:
             with obs.span("serve/dispatch", rows=rows,
-                          riders=len(batch)):
-                out = booster.predict(X)
+                          riders=len(batch), kind=kind):
+                out = (booster.predict(X, pred_contrib=True)
+                       if kind == "contrib" else booster.predict(X))
         except Exception as e:
             for req in batch:
                 _resolve(req, exc=e)
@@ -372,6 +394,9 @@ class PredictService:
     def _record(self, batch: List[PredictRequest], rows: int,
                 booster=None, cause: str = "fill") -> None:
         obs.inc("serve.dispatches")
+        explain = getattr(batch[0], "kind", "predict") == "contrib"
+        if explain:
+            obs.inc("serve.explain_requests", len(batch))
         if len(batch) > 1:
             obs.inc("serve.coalesced_requests", len(batch))
         obs.set_gauge("serve.batch_fill_ratio",
@@ -379,11 +404,16 @@ class PredictService:
         if obs.enabled():
             # flush-cause taxonomy + per-rider end-to-end latency: the
             # decomposition the slo.* gauges derive from (one bool
-            # gate for the per-request loop)
+            # gate for the per-request loop). Explain riders feed their
+            # own window too, so slo.explain_p99_ms decomposes the
+            # mixed workload without muddying the predict e2e signal.
             obs.inc("serve.flush_cause", cause=cause)
             now = time.monotonic()
             for req in batch:
-                obs.observe("serve/e2e", max(now - req.t_enqueue, 0.0))
+                e2e = max(now - req.t_enqueue, 0.0)
+                obs.observe("serve/e2e", e2e)
+                if explain:
+                    obs.observe("serve/explain", e2e)
         # liveness from the LOOP, not just the predict instrumentation:
         # /readyz must track "the dispatcher is draining work" even
         # with a model whose predicts error

@@ -34,6 +34,7 @@ from ..utils.hbm import (SERVE_HBM_FRACTION, hbm_bytes_limit,
                          stacked_forest_bytes)
 
 __all__ = ["TREE_AXIS", "tree_mesh", "place_tree_sharded",
+           "place_tree_axis", "place_shap_sharded",
            "replicate_on", "engine_kind", "forest_bytes_estimate",
            "enable_tree_sharding", "auto_shard_mesh"]
 
@@ -74,6 +75,29 @@ def place_tree_sharded(stacked: Dict, class_idx, mesh: Mesh
                                      *([None] * (v.ndim - 1)))))
         for k, v in stacked.items()}
     return placed, replicate_on(mesh, class_idx)
+
+
+def place_tree_axis(mesh: Mesh, arr):
+    """Commit one host ``[T, ...]`` array with its leading tree axis
+    split over ``mesh`` (trailing axes replicated) — the per-chunk
+    routing-bit upload of the tree-sharded SHAP scan."""
+    return jax.device_put(
+        arr, NamedSharding(mesh, P(TREE_AXIS,
+                                   *([None] * (np.ndim(arr) - 1)))))
+
+
+def place_shap_sharded(tables: Dict, mesh: Mesh) -> Dict:
+    """Commit stacked SHAP path tables (``ops/shap.py::
+    build_shap_tables``, every array leading with the ``[T]`` axis)
+    tree-sharded over ``mesh``. A tree count the mesh does not divide
+    places replicated instead — the engine's pad path
+    (``_shap_tables_for``) prevents that, mirroring
+    :func:`place_tree_sharded`'s never-crash policy."""
+    T = int(next(iter(tables.values())).shape[0])
+    D = int(mesh.devices.size)
+    if D <= 1 or T % D != 0:
+        return {k: replicate_on(mesh, v) for k, v in tables.items()}
+    return {k: place_tree_axis(mesh, v) for k, v in tables.items()}
 
 
 def engine_kind(engine) -> str:

@@ -124,6 +124,25 @@ rm -f "$SERVE_JSON"
 JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/lightgbm_tpu_jax_cache}" \
 python benchmarks/serve_bench.py --smoke 2>&1 | tee "$SERVE_JSON" \
   || SERVE_SMOKE=0
+# mixed predict+explain leg riding the same smoke (device SHAP through
+# the service: contrib warmup, half-explain load, zero drops + zero
+# warm compiles; docs/serving.md "Mixed predict + explain workloads")
+# — enforced absolutely by obs_trend.py and by exit 10 here
+SHAP_SMOKE=$(python - "$SERVE_JSON" shap_smoke <<'PY'
+import json, sys
+v = 0
+try:
+    for ln in open(sys.argv[1]):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            d = json.loads(ln)
+            if sys.argv[2] in d:
+                v = int(d[sys.argv[2]])
+except Exception:
+    v = 0
+print(v)
+PY
+)
 
 # static analysis (docs/static-analysis.md): the five drift linters —
 # capability-gate / config-knobs / obs-names / collective-safety /
@@ -140,12 +159,13 @@ LINT_FINDINGS=$(cat "$LINT_COUNT_FILE" 2>/dev/null || echo -1)
 # dots/seconds from this run plus compile count and peak-HBM estimate
 # read back from the snapshot. A malformed dump FAILS the gate — a
 # check that silently skips its own telemetry is how telemetry rots.
-python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" "$SERVE_JSON" "$ELASTIC_SMOKE" "$FLEET_SMOKE" <<'PY' >> scripts/check_timings.log
+python - "$OBS_JSON" "$MODE" "$DOTS" "$((T1 - T0))" "$REV" "$STREAM_DRYRUN" "$CHAOS_SMOKE" "$LINT_FINDINGS" "$SERVE_SMOKE" "$SERVE_JSON" "$ELASTIC_SMOKE" "$FLEET_SMOKE" "$SHAP_SMOKE" <<'PY' >> scripts/check_timings.log
 import json, sys, time
 path, mode, dots, secs, rev, stream_ok, chaos_ok, lint, serve_ok = sys.argv[1:10]
 serve_json = sys.argv[10] if len(sys.argv) > 10 else ""
 elastic_ok = sys.argv[11] if len(sys.argv) > 11 else "0"
 fleet_ok = sys.argv[12] if len(sys.argv) > 12 else "0"
+shap_ok = sys.argv[13] if len(sys.argv) > 13 else "0"
 try:
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     snap = json.loads(lines[-1])
@@ -211,6 +231,9 @@ print("obs " + json.dumps({
     # concurrent serving: coalesce + evict + swap under load with zero
     # drops and zero warm compiles (benchmarks/serve_bench.py --smoke)
     "serve_smoke": int(serve_ok),
+    # mixed predict+explain leg of the same smoke: device SHAP through
+    # the service lanes with zero drops and zero warm compiles
+    "shap_smoke": int(shap_ok),
     # windowed serving queue-wait p99 from the smoke's SLO plane —
     # obs_trend.py flags it regressing past its trailing median
     # (queue-pressure creep: budget misconfig, dispatch slowdown)
@@ -248,6 +271,11 @@ if [[ "$SERVE_SMOKE" != 1 ]]; then
   echo "check.sh: serving smoke FAILED (coalesce+evict+swap under" \
        "load; status logged)"
   exit 7
+fi
+if [[ "$SHAP_SMOKE" != 1 ]]; then
+  echo "check.sh: mixed predict+explain smoke FAILED (device SHAP" \
+       "through the service; status logged)"
+  exit 10
 fi
 
 # perf-regression sentinel (CHECK_TREND=1 to enforce): compare the obs

@@ -50,6 +50,11 @@ regressed past its threshold —
   the concurrent serving smoke (``benchmarks/serve_bench.py --smoke``
   — coalesce + LRU-evict + mid-traffic hot-swap under load) dropped a
   request, compiled a warm-path program, or crashed;
+- ``shap_smoke`` == 0 in the NEWEST run (absolute, like serve_smoke):
+  the mixed predict+explain leg of the same smoke (device SHAP
+  through the service's ``(model, kind)`` lanes; docs/serving.md
+  "Mixed predict + explain workloads") dropped a request, compiled a
+  warm-path program, or served wrong contributions;
 - ``fleet_smoke`` == 0 in the NEWEST run (absolute, like
   elastic_smoke): the serving-fleet kill/join cycle riding the chaos
   smoke (3 replicas behind the router, one SIGKILLed mid-load →
@@ -204,6 +209,16 @@ def check_trend(entries: List[Dict[str, Any]], window: int,
             "coalesce + LRU-evict + mid-traffic-swap load dropped a "
             "request, compiled a warm-path program, or crashed "
             "(benchmarks/serve_bench.py --smoke)")
+    # the explain leg of the same smoke is absolute too: a warm SHAP
+    # dispatch that compiles, a mixed-lane drop, or served
+    # contributions diverging from the published model is broken NOW
+    if _num(newest, "shap_smoke") == 0.0:
+        failures.append(
+            "mixed predict+explain smoke FAILED (shap_smoke=0): the "
+            "device-SHAP serving leg dropped a request, compiled a "
+            "warm-path program, or served wrong contributions "
+            "(benchmarks/serve_bench.py --smoke; docs/serving.md "
+            "'Mixed predict + explain workloads')")
     # static analysis is absolute the same way: findings are drift
     # bugs NOW (gate literal outside the capability table, raw knob
     # read, collective inside a lax.switch branch...), and -1 means

@@ -120,6 +120,25 @@ def test_fleet_smoke_failure_fails_even_without_history(tmp_path):
     assert rc == 0, out
 
 
+def test_shap_smoke_failure_fails_even_without_history(tmp_path):
+    """The mixed predict+explain pin is ABSOLUTE like serve_smoke: a
+    shap_smoke=0 newest entry (the explain leg dropped a request,
+    compiled a warm SHAP program, or served wrong contributions)
+    fails with no baseline at all, and a 1 (or an absent key, for
+    pre-SHAP logs) stays green."""
+    bad = "obs " + json.dumps(
+        dict(json.loads(_obs_line()[len("obs "):]), shap_smoke=0))
+    rc, out = _run(tmp_path, [bad])
+    assert rc == 1
+    assert "shap_smoke" in out
+    good = "obs " + json.dumps(
+        dict(json.loads(_obs_line()[len("obs "):]), shap_smoke=1))
+    rc, out = _run(tmp_path, [good])
+    assert rc == 0, out
+    rc, out = _run(tmp_path, [_obs_line()])   # key absent: old logs
+    assert rc == 0, out
+
+
 def test_compile_and_hbm_regressions_fail(tmp_path):
     base = [_obs_line() for _ in range(4)]
     rc, out = _run(tmp_path, base + [_obs_line(compile_requests=200)])
